@@ -1,0 +1,33 @@
+"""mpi_cuda_largescaleknn_tpu — a TPU-native large-scale exact-kNN framework.
+
+A brand-new JAX/XLA/Pallas framework with the capabilities of the reference
+MPI+CUDA system (ingowald/MPI-CUDA-LargeScaleKNN): for a very large set of 3D
+points — larger than one accelerator's memory — compute, for every point, the
+distance to its k-th nearest neighbor.
+
+Architecture (TPU-first, not a translation):
+
+- ``ops.build_tree``   — left-balanced implicit kd-tree built with O(log N)
+  ``lax.sort`` passes (the reference builds it with GPU sort kernels inside the
+  ``cudaKDTree`` submodule, called at ``unorderedDataVariant.cu:161``).
+- ``ops.candidates``   — persistent per-query top-k candidate state as SoA
+  ``(f32[N,k] dist^2, i32[N,k] idx)`` arrays, with the same init/adopt/extract
+  semantics as ``cukd::FlexHeapCandidateList`` (``unorderedDataVariant.cu:84-102``).
+- ``ops.brute_force``  — exact blocked kNN update (VPU outer-difference form).
+- ``ops.traverse``     — stack-free kd-tree traversal engine (vectorized).
+- ``parallel.ring``    — the reference's MPI ring exchange
+  (``unorderedDataVariant.cu:173-205``) re-expressed as ``lax.ppermute`` over a
+  1-D ``jax.sharding.Mesh`` inside ``shard_map``: stationary queries + heaps,
+  rotating tree shards (the ring-attention-shaped pattern).
+- ``parallel.demand``  — the reference's bounds-pruned demand exchange with
+  global early exit (``prePartitionedDataVariant.cu:304-357``) re-expressed as
+  a ``lax.while_loop`` with per-device compute skipping and a ``pmax``-driven
+  all-done predicate.
+- ``io`` / ``cli``     — byte-compatible ``.float3`` input and ``.float``
+  distance output, and the exact 5-flag CLI surface of the two reference
+  binaries.
+"""
+
+__version__ = "0.1.0"
+
+from mpi_cuda_largescaleknn_tpu.core.config import KnnConfig  # noqa: F401

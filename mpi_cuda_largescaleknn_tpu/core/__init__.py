@@ -1,0 +1,9 @@
+from mpi_cuda_largescaleknn_tpu.core.config import KnnConfig  # noqa: F401
+from mpi_cuda_largescaleknn_tpu.core.types import (  # noqa: F401
+    PAD_SENTINEL,
+    Aabb,
+    CandidateState,
+    aabb_box_distance,
+    aabb_of_points,
+    pad_points,
+)

@@ -1,0 +1,39 @@
+"""Run configuration.
+
+The public configuration surface of the reference is its 5-flag CLI, identical
+in both programs (unorderedDataVariant.cu:114-135, prePartitionedDataVariant.cu:185-206):
+positional input path, ``-o`` output, ``-k`` int (required >= 1), ``-r`` float
+max search radius (default +inf), ``-g`` int GPU-affinity modulus.
+
+``KnnConfig`` carries that surface plus the TPU-side knobs the reference has no
+analogue for (tile sizes, engine selection, mesh size, checkpointing).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+
+@dataclasses.dataclass(frozen=True)
+class KnnConfig:
+    # --- reference-parity knobs -------------------------------------------
+    k: int = 0                       # `-k`; must be >= 1 to run
+    max_radius: float = math.inf     # `-r`; candidates beyond this never enter
+    device_affinity: int = 0         # `-g`; kept for CLI parity (no-op on TPU,
+                                     # the runtime owns device binding)
+
+    # --- TPU-side knobs ----------------------------------------------------
+    engine: str = "auto"             # "auto" | "bruteforce" | "tree" | "pallas"
+    query_tile: int = 2048           # queries processed per inner tile
+    point_tile: int = 2048           # tree points per inner tile
+    num_shards: int = 1              # size of the 1-D mesh axis
+    checkpoint_dir: str | None = None  # save heap state every round if set
+    profile_dir: str | None = None   # jax.profiler trace output
+    verbose: bool = False
+
+    def validate(self) -> None:
+        if self.k < 1:
+            raise ValueError("no k specified, or invalid k value")
+        if self.engine not in ("auto", "bruteforce", "tree", "pallas"):
+            raise ValueError(f"unknown engine '{self.engine}'")

@@ -1,0 +1,93 @@
+"""Core array types.
+
+Design notes vs. the reference:
+
+- Points are ``f32[N, 3]`` arrays (the reference's ``float3*`` device buffers).
+- The per-query candidate list is SoA ``(f32[N, k] dist2, i32[N, k] idx)``
+  kept sorted ascending by dist2, instead of the reference's packed
+  ``uint64_t`` (dist-bits << 32 | index) max-heap
+  (``cukd::FlexHeapCandidateList``, used at unorderedDataVariant.cu:84-85).
+  Semantics preserved exactly — see ops/candidates.py.
+- XLA needs static shapes, so every shard is padded to a uniform size with
+  ``PAD_SENTINEL`` coordinates. The reference already relies on uniform
+  padding in the prepartitioned variant (buffers sized to
+  ``maxNumPointsAnybodyHas``, prePartitionedDataVariant.cu:251-266) and on a
+  ``N+1`` slack alloc in the unordered one (unorderedDataVariant.cu:156-158);
+  we generalize: sentinel points sit at distance ~1e30 from any real point, so
+  their squared distance overflows f32 to +inf and they can never displace a
+  real candidate (nor a cutoff-radius slot) in the heap.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+# Far-away-but-finite coordinate for padding points. (1e30)^2 overflows f32 to
+# +inf, so any real-vs-sentinel distance is +inf (never inserted), while
+# finite-minus-finite subtraction avoids the inf-inf => nan trap.
+PAD_SENTINEL = 1.0e30
+
+
+class CandidateState(NamedTuple):
+    """Persistent per-query top-k accumulator (one row per query).
+
+    ``dist2`` ascending per row; empty slots hold ``max_radius**2`` (+inf when
+    no ``-r`` given) and idx -1 — mirroring FlexHeapCandidateList's
+    initialization with its cutoff radius and its "adopt existing buffer"
+    reopening with cutoff -1 (unorderedDataVariant.cu:84-85, :97).
+    """
+
+    dist2: jnp.ndarray  # f32[num_queries, k]
+    idx: jnp.ndarray    # i32[num_queries, k]
+
+
+class Aabb(NamedTuple):
+    """Axis-aligned bounding box = the reference's ``cukd::box_t<float3>``
+    (6 contiguous floats, prePartitionedDataVariant.cu:290-291)."""
+
+    lower: jnp.ndarray  # f32[3]
+    upper: jnp.ndarray  # f32[3]
+
+
+def aabb_of_points(points: jnp.ndarray, valid_mask: jnp.ndarray | None = None) -> Aabb:
+    """Bounds of the real (non-sentinel) points.
+
+    Reference computes this on the host over its own points
+    (prePartitionedDataVariant.cu:230-232). Empty set => lower=+inf, upper=-inf
+    (the ``setEmpty()`` convention).
+    """
+    if valid_mask is None:
+        valid_mask = points[:, 0] < PAD_SENTINEL / 2
+    big = jnp.float32(jnp.inf)
+    lo = jnp.min(jnp.where(valid_mask[:, None], points, big), axis=0)
+    hi = jnp.max(jnp.where(valid_mask[:, None], points, -big), axis=0)
+    return Aabb(lo, hi)
+
+
+def aabb_box_distance(a_lower, a_upper, b_lower, b_upper) -> jnp.ndarray:
+    """Min Euclidean distance between two AABBs.
+
+    Same formula as the reference's ``computeDistance``
+    (prePartitionedDataVariant.cu:150-155):
+    per-component ``max(0, max(a.lo-b.hi, b.lo-a.hi))``, then the norm.
+    Empty boxes (lo=+inf/hi=-inf) give +inf distance, i.e. always prunable.
+    """
+    diff = jnp.maximum(0.0, jnp.maximum(a_lower - b_upper, b_lower - a_upper))
+    d2 = jnp.sum(diff * diff, axis=-1)
+    # an empty box produces inf-inf=nan in the subtraction; treat as +inf
+    return jnp.where(jnp.isnan(d2), jnp.inf, jnp.sqrt(d2))
+
+
+def pad_points(points, padded_size: int):
+    """Pad ``f32[N,3]`` to ``f32[padded_size,3]`` with PAD_SENTINEL rows.
+
+    Returns (padded_points, valid_mask[padded_size]).
+    """
+    n = points.shape[0]
+    assert padded_size >= n, (padded_size, n)
+    pad = jnp.full((padded_size - n, 3), PAD_SENTINEL, dtype=jnp.float32)
+    out = jnp.concatenate([jnp.asarray(points, jnp.float32), pad], axis=0)
+    mask = jnp.arange(padded_size) < n
+    return out, mask

@@ -1,0 +1,12 @@
+from mpi_cuda_largescaleknn_tpu.ops.candidates import (  # noqa: F401
+    current_worst_radius,
+    extract_final_result,
+    init_candidates,
+    merge_candidates,
+)
+from mpi_cuda_largescaleknn_tpu.ops.brute_force import (  # noqa: F401
+    knn_update_bruteforce,
+    pairwise_dist2,
+)
+from mpi_cuda_largescaleknn_tpu.ops.build_tree import build_tree  # noqa: F401
+from mpi_cuda_largescaleknn_tpu.ops.traverse import knn_update_tree  # noqa: F401

@@ -1,0 +1,109 @@
+"""Exact blocked brute-force kNN update (the default query engine).
+
+The reference's inner hot path is a per-thread stack-free kd-tree traversal
+(``cukd::stackFree::knn`` called from ``runQuery``, unorderedDataVariant.cu:86).
+On a GPU, one scalar thread per query makes a branchy tree walk cheap; on a
+TPU the VPU/MXU want dense regular tiles, and for 3-component points an exact
+blocked distance evaluation is the hardware-native formulation (cf. TPU-KNN,
+arXiv:2206.14286). This module is that engine: for each (query-tile,
+point-tile) pair compute the full f32 squared-distance tile and fold it into
+the persistent candidate state.
+
+Exactness: dist2 is computed as ``(dx*dx + dy*dy) + dz*dz`` on f32 operands —
+the same value the reference's traversal computes per visited point — NOT via
+the ``|q|^2 + |p|^2 - 2 q.p`` MXU trick, whose cancellation error is
+unbounded relative to the direct form. For 3-component points the MXU would
+run at K=3/128 utilization anyway, so the VPU outer-difference form is both
+the exact and the fast choice on TPU. (Selection itself is exact — no
+accumulation across pairs — but XLA may contract ``a*b + c`` into FMA
+differently per fusion context, so distances agree across *engines* to
+<= 1 ulp, not always bit-for-bit; within one engine results are
+deterministic.)
+
+A kd-tree traversal engine also exists (ops/traverse.py) and is benchmarked
+against this one; sentinel-padded tiles cost O(N) per query here vs O(log N)
+there, but with perfect vectorization and no divergence.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from mpi_cuda_largescaleknn_tpu.core.types import PAD_SENTINEL, CandidateState
+from mpi_cuda_largescaleknn_tpu.ops.candidates import merge_candidates
+from mpi_cuda_largescaleknn_tpu.utils.math import cdiv
+
+
+def pairwise_dist2(q: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
+    """f32[Tq,3] x f32[Tp,3] -> f32[Tq,Tp] squared distances, fixed
+    summation order x,y,z."""
+    dx = q[:, 0:1] - p[None, :, 0]
+    dy = q[:, 1:2] - p[None, :, 1]
+    dz = q[:, 2:3] - p[None, :, 2]
+    return (dx * dx + dy * dy) + dz * dz
+
+
+def _pad_rows(arr, target, fill):
+    n = arr.shape[0]
+    if n == target:
+        return arr
+    pad_shape = (target - n,) + arr.shape[1:]
+    return jnp.concatenate([arr, jnp.full(pad_shape, fill, arr.dtype)], axis=0)
+
+
+def knn_update_bruteforce(state: CandidateState, queries: jnp.ndarray,
+                          points: jnp.ndarray, point_ids: jnp.ndarray | None = None,
+                          *, query_tile: int = 2048, point_tile: int = 2048
+                          ) -> CandidateState:
+    """Fold every ``points`` row into each query's candidate state.
+
+    Equivalent to one ``runQuery`` kernel launch of the reference
+    (unorderedDataVariant.cu:199-203): queries and state stay put, ``points``
+    is whatever tree shard is resident this round. Sentinel-padded rows in
+    either input are harmless (their distances are +inf / their results are
+    discarded by the caller).
+    """
+    num_q, k = state.dist2.shape
+    num_p = points.shape[0]
+    if point_ids is None:
+        point_ids = jnp.arange(num_p, dtype=jnp.int32)
+
+    qt = min(query_tile, max(num_q, 1))
+    pt = min(point_tile, max(num_p, 1))
+    nq_tiles = cdiv(num_q, qt)
+    np_tiles = cdiv(num_p, pt)
+
+    # pad to whole tiles; sentinel queries produce garbage rows we slice off,
+    # sentinel points produce +inf distances that never merge in
+    q_pad = _pad_rows(jnp.asarray(queries, jnp.float32), nq_tiles * qt, PAD_SENTINEL)
+    p_pad = _pad_rows(jnp.asarray(points, jnp.float32), np_tiles * pt, PAD_SENTINEL)
+    id_pad = _pad_rows(jnp.asarray(point_ids, jnp.int32), np_tiles * pt, -1)
+    d2_pad = _pad_rows(state.dist2, nq_tiles * qt, jnp.inf)
+    idx_pad = _pad_rows(state.idx, nq_tiles * qt, -1)
+
+    q_tiles = q_pad.reshape(nq_tiles, qt, 3)
+    p_tiles = p_pad.reshape(np_tiles, pt, 3)
+    id_tiles = id_pad.reshape(np_tiles, pt)
+    d2_tiles = d2_pad.reshape(nq_tiles, qt, k)
+    idx_tiles = idx_pad.reshape(nq_tiles, qt, k)
+
+    def one_query_tile(args):
+        q, hd2, hidx = args
+
+        def step(carry, tile):
+            st = CandidateState(*carry)
+            p_t, id_t = tile
+            d2 = pairwise_dist2(q, p_t)
+            st = merge_candidates(st, d2, jnp.broadcast_to(id_t[None, :], d2.shape))
+            return (st.dist2, st.idx), None
+
+        (hd2, hidx), _ = jax.lax.scan(step, (hd2, hidx), (p_tiles, id_tiles))
+        return hd2, hidx
+
+    # sequential over query tiles (bounds live memory to one [qt, pt] tile);
+    # each tile is qt*pt-wide data-parallel work, plenty for the VPU
+    out_d2, out_idx = jax.lax.map(one_query_tile, (q_tiles, d2_tiles, idx_tiles))
+    out_d2 = out_d2.reshape(nq_tiles * qt, k)[:num_q]
+    out_idx = out_idx.reshape(nq_tiles * qt, k)[:num_q]
+    return CandidateState(out_d2, out_idx)

@@ -1,0 +1,103 @@
+"""Left-balanced implicit kd-tree construction as XLA sort passes.
+
+TPU-native equivalent of ``cukd::buildTree(float3*, int N)`` (called at
+unorderedDataVariant.cu:161 and prePartitionedDataVariant.cu:271): an
+**in-place, pointer-free** kd-tree where the reordered point array *is* the
+tree — node ``i``'s children live at ``2i+1`` / ``2i+2``, every node is a
+point, the tree is complete and left-balanced, and the split dimension is
+round-robin by depth (``depth % 3``), so no per-node metadata exists at all.
+
+Algorithm (same complexity class as the GPU builder described in Wald,
+*GPU-friendly left-balanced k-d tree construction*, arXiv:2211.00120, but
+expressed as whole-array ops XLA:TPU is good at):
+
+  repeat ceil(log2(N+1)) times, once per tree level L:
+    1. sort all points by (current-node-tag, coordinate along L % 3)
+       — one multi-operand ``lax.sort``; finalized points have unique tags
+       and ride along inertly;
+    2. per contiguous tag segment of size n, the element at the segment's
+       left-balanced pivot rank F(n) becomes that node's point (its tag is
+       final); elements before it re-tag to child 2t+1, after it to 2t+2.
+
+  finally scatter each point to array slot == its tag.
+
+Everything is sort + searchsorted + elementwise — no scalar loops, no dynamic
+shapes, fully jittable and differentiable-by-construction irrelevant (pure
+integer/gather work).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def left_subtree_size(n: jnp.ndarray) -> jnp.ndarray:
+    """Number of nodes in the left subtree of a complete left-balanced binary
+    tree with ``n`` nodes (vectorized, int32).
+
+    With h = floor(log2(n)) and half = 2**(h-1):
+    F = (half - 1) + clamp(n - (2*half - 1), 0, half).
+    """
+    n = n.astype(jnp.int32)
+    h = 31 - jax.lax.clz(jnp.maximum(n, 1))
+    half = jnp.where(h >= 1, jnp.left_shift(jnp.int32(1), jnp.maximum(h - 1, 0)), 0)
+    f = (half - 1) + jnp.clip(n - (2 * half - 1), 0, half)
+    return jnp.where(n <= 1, 0, f)
+
+
+def node_depth(i: jnp.ndarray) -> jnp.ndarray:
+    """Depth of node index ``i`` in the implicit tree: floor(log2(i+1))."""
+    return 31 - jax.lax.clz(i.astype(jnp.int32) + 1)
+
+
+def build_tree(points: jnp.ndarray, point_ids: jnp.ndarray | None = None):
+    """Build the implicit left-balanced kd-tree.
+
+    Args:
+      points: f32[N, 3] (sentinel padding rows allowed — they are ordinary
+        far-away points and end up in far subtrees).
+      point_ids: optional i32[N] original identities to carry through the
+        permutation (the reference discards these; we keep them so neighbor
+        *indices* can be reported, a capability the reference computes but
+        throws away — unorderedDataVariant.cu:228 region).
+
+    Returns:
+      (tree f32[N,3], tree_ids i32[N]): tree[i] is node i's point.
+    """
+    points = jnp.asarray(points, jnp.float32)
+    n_total = points.shape[0]
+    if point_ids is None:
+        point_ids = jnp.arange(n_total, dtype=jnp.int32)
+    point_ids = jnp.asarray(point_ids, jnp.int32)
+    if n_total == 0:
+        return points, point_ids
+    num_levels = max(1, math.ceil(math.log2(n_total + 1)))
+
+    tags = jnp.zeros((n_total,), jnp.int32)
+    x, y, z = points[:, 0], points[:, 1], points[:, 2]
+    ids = point_ids
+    positions = jnp.arange(n_total, dtype=jnp.int32)
+
+    for level in range(num_levels):
+        dim = level % 3
+        coord = (x, y, z)[dim]
+        tags, _, x, y, z, ids = jax.lax.sort(
+            (tags, coord, x, y, z, ids), num_keys=2, is_stable=True)
+        seg_start = jnp.searchsorted(tags, tags, side="left").astype(jnp.int32)
+        seg_end = jnp.searchsorted(tags, tags, side="right").astype(jnp.int32)
+        seg_n = seg_end - seg_start
+        rank = positions - seg_start
+        pivot = left_subtree_size(seg_n)
+        level_min = (1 << level) - 1
+        active = tags >= level_min  # segments not yet finalized = this level's
+        new_tags = jnp.where(rank < pivot, 2 * tags + 1,
+                             jnp.where(rank == pivot, tags, 2 * tags + 2))
+        tags = jnp.where(active, new_tags, tags)
+
+    tree = jnp.zeros_like(points)
+    tree = tree.at[tags, 0].set(x).at[tags, 1].set(y).at[tags, 2].set(z)
+    tree_ids = jnp.zeros_like(ids).at[tags].set(ids)
+    return tree, tree_ids

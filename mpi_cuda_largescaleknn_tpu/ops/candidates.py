@@ -1,0 +1,85 @@
+"""Persistent per-query top-k candidate lists.
+
+TPU-native re-design of ``cukd::FlexHeapCandidateList`` (the reference's
+per-query GPU max-heap over packed ``uint64`` (dist2,idx) entries, constructed
+at unorderedDataVariant.cu:84-85 and reopened at :97). Semantics preserved:
+
+- **Fresh init with cutoff** (reference: constructor with ``cutoff >= 0``,
+  round 0 at unorderedDataVariant.cu:84-85): all k slots hold
+  ``max_radius**2`` with idx -1. A candidate enters only by being strictly
+  closer than the current worst slot, so nothing at or beyond ``max_radius``
+  is ever recorded — the ``-r`` search-radius bound.
+- **Adopt across rounds** (reference: ``cutoff == -1.f`` for rounds > 0):
+  the state simply persists; merging new candidates into the same arrays *is*
+  the cross-rank top-k merge. No special flag needed functionally.
+- **Extraction** (reference ``extractFinalResult``,
+  unorderedDataVariant.cu:89-103): result is ``sqrt`` of the k-th smallest
+  dist2; if fewer than k candidates were ever found the k-th slot still holds
+  the init value (``inf`` without ``-r``) and the output stays ``inf``.
+- **Worst-radius reduction** (reference: per-thread
+  ``cukd::atomicMax(pMaxRadius, sqrt(cl.maxRadius2()))``,
+  prePartitionedDataVariant.cu:91-94): a masked ``jnp.max`` over the k-th
+  column — no atomics on TPU.
+
+Layout: SoA ``(f32[Q,k] dist2 ascending, i32[Q,k] idx)`` instead of a packed
+u64 heap. Sorted-ascending rows make the merge a (stable) sort-and-slice,
+which maps onto XLA's vectorized sorts; a binary heap's pointer-chasing would
+not vectorize on the VPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from mpi_cuda_largescaleknn_tpu.core.types import CandidateState
+
+
+def init_candidates(num_queries: int, k: int, max_radius: float = jnp.inf) -> CandidateState:
+    """Fresh candidate state bounded by ``max_radius`` (f32 semantics:
+    slots hold ``float32(max_radius)**2``)."""
+    r = jnp.float32(max_radius)
+    dist2 = jnp.full((num_queries, k), r * r, dtype=jnp.float32)
+    idx = jnp.full((num_queries, k), -1, dtype=jnp.int32)
+    return CandidateState(dist2, idx)
+
+
+def merge_candidates(state: CandidateState, cand_dist2: jnp.ndarray,
+                     cand_idx: jnp.ndarray) -> CandidateState:
+    """Merge a tile of candidates ``(f32[Q,T], i32[Q,T])`` into the state.
+
+    Keeps the k smallest of the union per row. Stable ordering with existing
+    entries first reproduces the heap's strict-< insertion: a candidate tied
+    with the current worst slot does not displace it.
+    """
+    k = state.dist2.shape[1]
+    t = cand_dist2.shape[1]
+    if t > k:
+        # pre-reduce the tile to its own k best to keep the sort width at 2k
+        neg, pos = jax.lax.top_k(-cand_dist2, k)
+        cand_dist2 = -neg
+        cand_idx = jnp.take_along_axis(cand_idx, pos, axis=1)
+    cat_d2 = jnp.concatenate([state.dist2, cand_dist2], axis=1)
+    cat_idx = jnp.concatenate([state.idx, cand_idx], axis=1)
+    sorted_d2, sorted_idx = jax.lax.sort((cat_d2, cat_idx), num_keys=1,
+                                         dimension=1, is_stable=True)
+    return CandidateState(sorted_d2[:, :k], sorted_idx[:, :k])
+
+
+def extract_final_result(state: CandidateState) -> jnp.ndarray:
+    """k-th-NN distance per query: ``sqrt(kth smallest dist2)``; stays ``inf``
+    when fewer than k neighbors were found (reference
+    unorderedDataVariant.cu:97-102; ``sqrt(inf) == inf`` so no branch)."""
+    return jnp.sqrt(state.dist2[:, -1])
+
+
+def current_worst_radius(state: CandidateState, valid_mask: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Max over (real) queries of their current k-th candidate distance — the
+    pruning cutoff the prepartitioned reference maintains in managed memory
+    via ``atomicMax`` (prePartitionedDataVariant.cu:91-94,297-298)."""
+    kth = state.dist2[:, -1]
+    if valid_mask is not None:
+        kth = jnp.where(valid_mask, kth, -jnp.inf)
+    # clamp: a shard with zero real queries must yield 0 (prune everything),
+    # not sqrt(-inf) = nan, which would poison pruning comparisons
+    return jnp.sqrt(jnp.maximum(jnp.max(kth), 0.0))

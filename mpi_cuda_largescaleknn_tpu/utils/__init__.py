@@ -1,0 +1,1 @@
+from mpi_cuda_largescaleknn_tpu.utils.math import cdiv, next_pow2, round_up  # noqa: F401

@@ -1,0 +1,20 @@
+"""Small integer helpers (host-side, static-shape arithmetic)."""
+
+
+def cdiv(a: int, b: int) -> int:
+    """Ceiling division (the reference's ``cukd::divRoundUp``,
+    used for launch geometry at unorderedDataVariant.cu:199)."""
+    return -(-a // b)
+
+
+def round_up(a: int, b: int) -> int:
+    """Round ``a`` up to the next multiple of ``b``."""
+    return cdiv(a, b) * b
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (n >= 1)."""
+    p = 1
+    while p < n:
+        p <<= 1
+    return p

@@ -1,0 +1,32 @@
+"""Test fixture: run everything on CPU with 8 virtual devices.
+
+This is the TPU-world "multi-node without a cluster" fixture (SURVEY.md §4):
+the ring/demand engines are exercised on a 1-D mesh of 8 host-platform
+devices, standing in for the reference's `mpirun -n 8` runs.
+
+Environment hardening: this container's sitecustomize may register an `axon`
+accelerator PJRT plugin (and import jax) before this file runs. Tests must
+never touch the accelerator tunnel — it is single-client and a wedged tunnel
+would hang the suite — so we (a) force the platform to cpu both via env and
+via jax.config (the env var alone is too late once jax is imported), and
+(b) drop every non-CPU backend factory.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax._src import xla_bridge as _xb
+
+    for _name in list(getattr(_xb, "_backend_factories", {})):
+        if _name != "cpu":
+            _xb._backend_factories.pop(_name, None)
+except Exception:
+    pass

@@ -1,0 +1,52 @@
+"""Brute-force numpy oracle — ground truth for every engine.
+
+Mirrors the reference's semantics exactly (f32 arithmetic, strict-< radius
+cutoff, k-th slot stays at cutoff^2 when fewer than k neighbors exist, the
+query point itself counts as its own neighbor at distance 0).
+"""
+
+import numpy as np
+
+
+def random_points(n, seed=0, scale=1.0):
+    rng = np.random.default_rng(seed)
+    return (rng.random((n, 3)) * scale).astype(np.float32)
+
+
+def pairwise_dist2_np(queries, points):
+    q = np.asarray(queries, np.float32)
+    p = np.asarray(points, np.float32)
+    dx = q[:, 0:1] - p[None, :, 0]
+    dy = q[:, 1:2] - p[None, :, 1]
+    dz = q[:, 2:3] - p[None, :, 2]
+    return (dx * dx + dy * dy) + dz * dz
+
+
+def kth_nn_dist2(queries, points, k, max_radius=np.inf):
+    """f32[Q] k-th smallest squared distance (or cutoff^2 if under-full)."""
+    d2 = pairwise_dist2_np(queries, points)
+    r = np.float32(max_radius)
+    r2 = np.float32(r * r)
+    out = np.empty(d2.shape[0], np.float32)
+    for i, row in enumerate(d2):
+        cand = np.sort(row[row < r2], kind="stable")
+        out[i] = cand[k - 1] if len(cand) >= k else r2
+    return out
+
+
+def kth_nn_dist(queries, points, k, max_radius=np.inf):
+    """The reference's final output: sqrt of the k-th smallest dist^2
+    (stays inf / at the radius when under-full)."""
+    return np.sqrt(kth_nn_dist2(queries, points, k, max_radius))
+
+
+def assert_dist_equal(got, want):
+    """Engine-vs-oracle comparison: XLA fuses a*b+c into FMA, so engine f32
+    distances can differ from numpy's by 1-2 ulp. All *engines* must agree
+    bit-for-bit with each other; vs this numpy oracle we allow <=2 ulp and
+    require the inf pattern (under-full queries) to match exactly."""
+    got = np.asarray(got)
+    want = np.asarray(want)
+    np.testing.assert_array_equal(np.isinf(got), np.isinf(want))
+    finite = np.isfinite(want)
+    np.testing.assert_allclose(got[finite], want[finite], rtol=5e-7, atol=1e-37)

@@ -1,0 +1,64 @@
+import numpy as np
+import pytest
+
+from mpi_cuda_largescaleknn_tpu.core.types import pad_points
+from mpi_cuda_largescaleknn_tpu.ops.brute_force import knn_update_bruteforce
+from mpi_cuda_largescaleknn_tpu.ops.candidates import extract_final_result, init_candidates
+
+from .oracle import assert_dist_equal, kth_nn_dist, random_points
+
+
+
+
+@pytest.mark.parametrize("n,k", [(100, 1), (257, 8), (1000, 33)])
+def test_matches_oracle_self_query(n, k):
+    pts = random_points(n)
+    st = init_candidates(n, k)
+    st = knn_update_bruteforce(st, pts, pts, query_tile=128, point_tile=128)
+    got = np.array(extract_final_result(st))
+    want = kth_nn_dist(pts, pts, k)
+    assert_dist_equal(got, want)
+
+
+def test_k_greater_than_n_gives_inf():
+    pts = random_points(5)
+    st = init_candidates(5, 8)
+    st = knn_update_bruteforce(st, pts, pts)
+    assert np.all(np.isinf(np.array(extract_final_result(st))))
+
+
+def test_max_radius_bound():
+    pts = random_points(300, seed=3)
+    k = 10
+    r = 0.05
+    st = init_candidates(300, k, max_radius=r)
+    st = knn_update_bruteforce(st, pts, pts, query_tile=64, point_tile=64)
+    got = np.array(extract_final_result(st))
+    want = kth_nn_dist(pts, pts, k, max_radius=r)
+    assert_dist_equal(got, want)
+
+
+def test_incremental_rounds_equal_one_shot():
+    # stationary heaps + two tree shards folded in sequentially == all at once
+    pts = random_points(400, seed=5)
+    q = random_points(120, seed=6)
+    k = 7
+    one = knn_update_bruteforce(init_candidates(120, k), q, pts,
+                                query_tile=64, point_tile=64)
+    st = init_candidates(120, k)
+    st = knn_update_bruteforce(st, q, pts[:150], query_tile=64, point_tile=64)
+    st = knn_update_bruteforce(st, q, pts[150:],
+                               point_ids=np.arange(150, 400, dtype=np.int32),
+                               query_tile=64, point_tile=64)
+    np.testing.assert_array_equal(np.array(one.dist2), np.array(st.dist2))
+
+
+def test_sentinel_padding_is_inert():
+    pts = random_points(100, seed=9)
+    padded, _ = pad_points(pts, 160)
+    k = 4
+    st_pad = knn_update_bruteforce(init_candidates(100, k), pts, padded,
+                                   query_tile=32, point_tile=32)
+    st_ref = knn_update_bruteforce(init_candidates(100, k), pts, pts,
+                                   query_tile=32, point_tile=32)
+    np.testing.assert_array_equal(np.array(st_pad.dist2), np.array(st_ref.dist2))

@@ -1,0 +1,73 @@
+import numpy as np
+import pytest
+
+from .oracle import random_points
+
+from mpi_cuda_largescaleknn_tpu.ops.build_tree import build_tree, left_subtree_size
+
+import jax.numpy as jnp
+
+
+
+
+def test_left_subtree_size_small_values():
+    # hand-checked values for complete left-balanced trees
+    want = {1: 0, 2: 1, 3: 1, 4: 2, 5: 3, 6: 3, 7: 3, 8: 4, 9: 5, 10: 6,
+            11: 7, 12: 7, 15: 7, 16: 8, 31: 15}
+    got = np.array(left_subtree_size(jnp.array(sorted(want))))
+    np.testing.assert_array_equal(got, [want[n] for n in sorted(want)])
+
+
+def _check_kd_property(tree, node=0, depth=0):
+    """Recursive host-side check: every node's left subtree is <= it and right
+    subtree >= it along the node's round-robin split dimension."""
+    n = len(tree)
+    if node >= n:
+        return
+    dim = depth % 3
+
+    def subtree_nodes(root):
+        out, stack = [], [root]
+        while stack:
+            i = stack.pop()
+            if i < n:
+                out.append(i)
+                stack += [2 * i + 1, 2 * i + 2]
+        return out
+
+    for c in subtree_nodes(2 * node + 1):
+        assert tree[c, dim] <= tree[node, dim], (node, c, dim)
+    for c in subtree_nodes(2 * node + 2):
+        assert tree[c, dim] >= tree[node, dim], (node, c, dim)
+    _check_kd_property(tree, 2 * node + 1, depth + 1)
+    _check_kd_property(tree, 2 * node + 2, depth + 1)
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 7, 8, 100, 255, 256, 257, 1000])
+def test_tree_is_permutation_and_kd_ordered(n):
+    pts = random_points(n, seed=n)
+    tree, tree_ids = build_tree(pts)
+    tree = np.array(tree)
+    tree_ids = np.array(tree_ids)
+    # permutation of the input, ids consistent
+    assert sorted(tree_ids.tolist()) == list(range(n))
+    np.testing.assert_array_equal(tree, pts[tree_ids])
+    _check_kd_property(tree)
+
+
+def test_duplicate_coordinates():
+    rng = np.random.default_rng(1)
+    pts = rng.integers(0, 3, (64, 3)).astype(np.float32)  # heavy ties
+    tree, tree_ids = build_tree(pts)
+    assert sorted(np.array(tree_ids).tolist()) == list(range(64))
+    _check_kd_property(np.array(tree))
+
+
+def test_input_order_invariance_of_structure():
+    # permuting the input must not change the set of points at each node when
+    # coordinates are unique (left-balanced layout is canonical up to ties)
+    pts = random_points(200, seed=7)
+    tree1, _ = build_tree(pts)
+    perm = np.random.default_rng(2).permutation(200)
+    tree2, _ = build_tree(pts[perm])
+    np.testing.assert_array_equal(np.array(tree1), np.array(tree2))

@@ -1,0 +1,74 @@
+import jax.numpy as jnp
+import numpy as np
+
+from mpi_cuda_largescaleknn_tpu.ops.candidates import (
+    current_worst_radius,
+    extract_final_result,
+    init_candidates,
+    merge_candidates,
+)
+
+
+def test_init_default_is_inf():
+    st = init_candidates(4, 3)
+    assert np.all(np.isinf(st.dist2))
+    assert np.all(np.array(st.idx) == -1)
+
+
+def test_init_with_radius_holds_r2():
+    st = init_candidates(2, 3, max_radius=2.0)
+    np.testing.assert_array_equal(np.array(st.dist2), np.full((2, 3), 4.0, np.float32))
+
+
+def test_merge_keeps_k_smallest_sorted():
+    st = init_candidates(1, 3)
+    st = merge_candidates(st, jnp.array([[5.0, 1.0, 3.0, 2.0]]),
+                          jnp.array([[10, 11, 12, 13]], jnp.int32))
+    np.testing.assert_array_equal(np.array(st.dist2[0]), [1.0, 2.0, 3.0])
+    np.testing.assert_array_equal(np.array(st.idx[0]), [11, 13, 12])
+
+
+def test_radius_cutoff_is_strict():
+    # candidate exactly at r^2 must NOT displace the cutoff slot
+    st = init_candidates(1, 2, max_radius=2.0)
+    st = merge_candidates(st, jnp.array([[4.0, 1.0]]), jnp.array([[7, 8]], jnp.int32))
+    np.testing.assert_array_equal(np.array(st.dist2[0]), [1.0, 4.0])
+    np.testing.assert_array_equal(np.array(st.idx[0]), [8, -1])
+
+
+def test_adopt_across_rounds_equals_single_merge():
+    # two sequential merges == one merge of the union (cross-round heap adoption,
+    # reference round>0 cutoff=-1 semantics)
+    rng = np.random.default_rng(0)
+    a = rng.random((5, 7), dtype=np.float32)
+    b = rng.random((5, 9), dtype=np.float32)
+    ia = np.arange(7, dtype=np.int32).reshape(1, -1).repeat(5, 0)
+    ib = (100 + np.arange(9, dtype=np.int32)).reshape(1, -1).repeat(5, 0)
+    st1 = merge_candidates(merge_candidates(init_candidates(5, 4), jnp.array(a), jnp.array(ia)),
+                           jnp.array(b), jnp.array(ib))
+    st2 = merge_candidates(init_candidates(5, 4),
+                           jnp.concatenate([jnp.array(a), jnp.array(b)], axis=1),
+                           jnp.concatenate([jnp.array(ia), jnp.array(ib)], axis=1))
+    np.testing.assert_array_equal(np.array(st1.dist2), np.array(st2.dist2))
+
+
+def test_extract_underfull_stays_inf():
+    st = init_candidates(1, 3)
+    st = merge_candidates(st, jnp.array([[1.0, 4.0]]), jnp.array([[0, 1]], jnp.int32))
+    out = np.array(extract_final_result(st))
+    assert out[0] == np.inf
+
+
+def test_extract_sqrt_of_kth():
+    st = init_candidates(1, 2)
+    st = merge_candidates(st, jnp.array([[9.0, 4.0, 16.0]]), jnp.array([[0, 1, 2]], jnp.int32))
+    np.testing.assert_allclose(np.array(extract_final_result(st)), [3.0])
+
+
+def test_worst_radius_masks_padding():
+    st = init_candidates(3, 1)
+    st = merge_candidates(st, jnp.array([[4.0], [9.0], [1.0]]),
+                          jnp.zeros((3, 1), jnp.int32))
+    mask = jnp.array([True, False, True])  # middle row is a padded query
+    assert float(current_worst_radius(st, mask)) == 2.0
+    assert float(current_worst_radius(st)) == 3.0

@@ -1,0 +1,56 @@
+import numpy as np
+import pytest
+
+from mpi_cuda_largescaleknn_tpu.core.types import pad_points
+from mpi_cuda_largescaleknn_tpu.ops.build_tree import build_tree
+from mpi_cuda_largescaleknn_tpu.ops.candidates import extract_final_result, init_candidates
+from mpi_cuda_largescaleknn_tpu.ops.traverse import knn_update_tree
+
+from .oracle import assert_dist_equal, kth_nn_dist, random_points
+
+
+
+
+@pytest.mark.parametrize("n,k", [(50, 1), (100, 5), (257, 8), (600, 20)])
+def test_traversal_matches_oracle(n, k):
+    pts = random_points(n, seed=n)
+    tree, tree_ids = build_tree(pts)
+    st = init_candidates(n, k)
+    st = knn_update_tree(st, pts, tree, tree_ids)
+    got = np.array(extract_final_result(st))
+    want = kth_nn_dist(pts, pts, k)
+    assert_dist_equal(got, want)
+
+
+def test_traversal_with_radius():
+    pts = random_points(300, seed=11)
+    k, r = 6, 0.08
+    tree, tree_ids = build_tree(pts)
+    st = init_candidates(300, k, max_radius=r)
+    st = knn_update_tree(st, pts, tree, tree_ids)
+    assert_dist_equal(np.array(extract_final_result(st)),
+                      kth_nn_dist(pts, pts, k, max_radius=r))
+
+
+def test_traversal_k_exceeds_n():
+    pts = random_points(6, seed=3)
+    tree, tree_ids = build_tree(pts)
+    st = knn_update_tree(init_candidates(6, 9), pts, tree, tree_ids)
+    assert np.all(np.isinf(np.array(extract_final_result(st))))
+
+
+def test_traversal_on_sentinel_padded_tree():
+    pts = random_points(100, seed=13)
+    padded, _ = pad_points(pts, 128)
+    tree, tree_ids = build_tree(padded)
+    st = knn_update_tree(init_candidates(100, 4), pts, tree, tree_ids)
+    assert_dist_equal(np.array(extract_final_result(st)),
+                      kth_nn_dist(pts, pts, 4))
+
+
+def test_empty_tree_is_noop():
+    pts = random_points(10, seed=1)
+    st0 = init_candidates(10, 3)
+    st = knn_update_tree(st0, pts, np.zeros((0, 3), np.float32),
+                         np.zeros((0,), np.int32))
+    np.testing.assert_array_equal(np.array(st.dist2), np.array(st0.dist2))
