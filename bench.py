@@ -1,0 +1,85 @@
+"""Benchmark — BASELINE config #1: unordered, single device, 1M float3, k=8.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "queries/s", "vs_baseline": N}
+
+The reference publishes no numbers anywhere (BASELINE.md: no timers, no
+benchmarks dir), so ``vs_baseline`` is measured against a DOCUMENTED ESTIMATE
+of the reference's throughput on its era hardware: ~2e7 exact-kNN
+queries/sec for 1M points k=8 on a V100-class GPU (order-of-magnitude from
+the cudaKDTree papers' reported traversal rates, arXiv:2210.12859 /
+2211.00120). vs_baseline = ours / that estimate.
+
+Robustness: the TPU is reached through a tunnel that can be unavailable; the
+probe runs in a subprocess with a timeout and the bench falls back to CPU
+(reported in the JSON) rather than hanging the driver.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REFERENCE_ESTIMATE_QPS = 2.0e7  # documented estimate, see module docstring
+N_POINTS = int(os.environ.get("BENCH_N", 1_000_000))
+K = int(os.environ.get("BENCH_K", 8))
+
+
+def _tpu_available(timeout_s: float = 60.0) -> bool:
+    probe = ("import jax; d=jax.devices(); "
+             "import sys; sys.exit(0 if d and d[0].platform != 'cpu' else 1)")
+    try:
+        return subprocess.run([sys.executable, "-c", probe],
+                              timeout=timeout_s, capture_output=True).returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main() -> int:
+    if not _tpu_available():
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        platform = "cpu-fallback"
+    else:
+        platform = "tpu"
+
+    import numpy as np
+
+    from mpi_cuda_largescaleknn_tpu.core.config import KnnConfig
+    from mpi_cuda_largescaleknn_tpu.models.unordered import UnorderedKNN
+    from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+
+    n = N_POINTS if platform == "tpu" else min(N_POINTS, 20_000)
+    rng = np.random.default_rng(7)
+    pts = rng.random((n, 3)).astype(np.float32)
+
+    engine = os.environ.get("BENCH_ENGINE", "auto")
+    cfg = KnnConfig(k=K, engine=engine)
+    model = UnorderedKNN(cfg, mesh=get_mesh(1))
+
+    model.run(pts)  # warm the compile cache at full shape
+    best = float("inf")
+    for _ in range(max(1, int(os.environ.get("BENCH_REPS", 2)))):
+        t0 = time.perf_counter()
+        out = model.run(pts)
+        best = min(best, time.perf_counter() - t0)
+    assert out.shape == (n,) and np.all(np.isfinite(out))
+
+    qps = n / best
+    print(json.dumps({
+        "metric": f"knn_queries_per_sec_unordered_{n}pts_k{K}_1dev",
+        "value": round(qps, 1),
+        "unit": "queries/s",
+        "vs_baseline": round(qps / REFERENCE_ESTIMATE_QPS, 4),
+        "platform": platform,
+        "engine": engine,
+        "seconds": round(best, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,89 @@
+"""Shared CLI parsing — the reference's exact 5-flag surface plus TPU knobs.
+
+The reference's hand-rolled argv loop (identical in both programs,
+unorderedDataVariant.cu:114-135 / prePartitionedDataVariant.cu:185-206):
+positional input path, ``-o`` output, ``-k`` int (required), ``-r`` float max
+search radius (default inf), ``-g`` GPU-affinity modulus, anything else ->
+usage error + exit(1). We preserve that contract verbatim and add
+double-dash TPU-side options the reference has no analogue for.
+"""
+
+from __future__ import annotations
+
+import math
+import sys
+
+from mpi_cuda_largescaleknn_tpu.core.config import KnnConfig
+
+TPU_FLAGS = """
+TPU-side options (no reference analogue):
+  --shards N        size of the 1-D device mesh (default: all devices)
+  --engine E        bruteforce | tree | pallas | auto (default auto)
+  --query-tile N    queries per inner tile (default 2048)
+  --point-tile N    tree points per inner tile (default 2048)
+  --profile-dir D   write a jax.profiler trace
+  --timings         print phase timings as JSON to stderr
+"""
+
+
+def usage(program: str, error: str) -> "NoReturn":  # noqa: F821
+    sys.stderr.write(f"Error: {error}\n\n")
+    sys.stderr.write(
+        f"{program} -k <k> [-r <maxRadius>] <input> -o <output>\n{TPU_FLAGS}")
+    sys.exit(1)
+
+
+def parse_args(program: str, argv: list[str]):
+    """Returns (config, in_path, out_path, extras dict)."""
+    k = 0
+    max_radius = math.inf
+    affinity = 0
+    in_path = ""
+    out_path = ""
+    extras = {"shards": None, "engine": "auto", "query_tile": 2048,
+              "point_tile": 2048, "profile_dir": None, "timings": False}
+    i = 0
+    try:
+        while i < len(argv):
+            arg = argv[i]
+            if arg == "-o":
+                i += 1; out_path = argv[i]
+            elif not arg.startswith("-"):
+                in_path = arg
+            elif arg == "-r":
+                i += 1; max_radius = float(argv[i])
+            elif arg == "-g":
+                i += 1; affinity = int(argv[i])
+            elif arg == "-k":
+                i += 1; k = int(argv[i])
+            elif arg == "--shards":
+                i += 1; extras["shards"] = int(argv[i])
+            elif arg == "--engine":
+                i += 1; extras["engine"] = argv[i]
+            elif arg == "--query-tile":
+                i += 1; extras["query_tile"] = int(argv[i])
+            elif arg == "--point-tile":
+                i += 1; extras["point_tile"] = int(argv[i])
+            elif arg == "--profile-dir":
+                i += 1; extras["profile_dir"] = argv[i]
+            elif arg == "--timings":
+                extras["timings"] = True
+            else:
+                usage(program, f"unknown cmdline arg '{arg}'")
+            i += 1
+    except (IndexError, ValueError):
+        usage(program, f"invalid or missing value for '{argv[i - 1] if i else ''}'")
+
+    if not in_path:
+        usage(program, "no input file name specified")
+    if not out_path:
+        usage(program, "no output file name specified")
+    if k < 1:
+        usage(program, "no k specified, or invalid k value")
+
+    cfg = KnnConfig(k=k, max_radius=max_radius, device_affinity=affinity,
+                    engine=extras["engine"], query_tile=extras["query_tile"],
+                    point_tile=extras["point_tile"],
+                    num_shards=extras["shards"] or 0,
+                    profile_dir=extras["profile_dir"])
+    return cfg, in_path, out_path, extras

@@ -1,0 +1,53 @@
+"""``tpuknn-prepartitioned`` — the ``cudaMpiKNN_prePartitionedData`` entry point.
+
+Reference contract (README.md:38-41):
+    mpirun -n numFiles ./cudaMpiKNN_prePartitionedData fileNames.txt -k 100 -o prefix
+TPU form:
+    python -m mpi_cuda_largescaleknn_tpu.cli.prepartitioned_main fileNames.txt \
+        -k 100 -o prefix [--shards R]
+
+One shard per listed file (count must equal the mesh size, the reference's
+``#files == ranks`` check, prePartitionedDataVariant.cu:215-216); outputs one
+``prefix_%06d.float`` per shard (:380-385).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from mpi_cuda_largescaleknn_tpu.cli.common import parse_args
+from mpi_cuda_largescaleknn_tpu.io.reader import read_list_of_file_names, read_points
+from mpi_cuda_largescaleknn_tpu.io.writer import write_rank_file
+from mpi_cuda_largescaleknn_tpu.models.prepartitioned import PrePartitionedKNN
+from mpi_cuda_largescaleknn_tpu.obs.trace import profile_trace
+from mpi_cuda_largescaleknn_tpu.parallel.mesh import AXIS, get_mesh
+
+
+def main(argv: list[str] | None = None) -> int:
+    cfg, in_path, out_prefix, extras = parse_args(
+        "tpuknn-prepartitioned", sys.argv[1:] if argv is None else argv)
+
+    file_names = read_list_of_file_names(in_path)
+    mesh = get_mesh(extras["shards"] if extras["shards"] is not None
+                    else len(file_names))
+    if len(file_names) != mesh.shape[AXIS]:
+        raise RuntimeError("number of input files does not match mesh size")
+
+    partitions = [read_points(f) for f in file_names]
+    for r, p in enumerate(partitions):
+        print(f"#{r}/{len(partitions)}: got {len(p)} points to work on")
+
+    model = PrePartitionedKNN(cfg, mesh=mesh)
+    with profile_trace(cfg.profile_dir):
+        results = model.run(partitions)
+    for r, dists in enumerate(results):
+        write_rank_file(out_prefix, r, dists)
+    print("done all queries...")
+    if extras["timings"]:
+        sys.stderr.write(model.timers.dump() + "\n")
+        sys.stderr.write(f"stats: {model.last_stats}\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,45 @@
+"""``tpuknn-unordered`` — the ``cudaMpiKNN_unorderedData`` entry point.
+
+Reference contract (README.md:30-33):
+    mpirun -n R ./cudaMpiKNN_unorderedData points.float3 -o distances.float -k 100
+TPU form (one process drives the whole mesh; no mpirun):
+    python -m mpi_cuda_largescaleknn_tpu.cli.unordered_main points.float3 \
+        -o distances.float -k 100 [--shards R]
+
+Byte-compatible ``.float3`` in / ``.float`` out, output in global point order
+(unorderedDataVariant.cu:229-237 layout).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from mpi_cuda_largescaleknn_tpu.cli.common import parse_args
+from mpi_cuda_largescaleknn_tpu.io.reader import read_file_portion
+from mpi_cuda_largescaleknn_tpu.io.writer import write_distances
+from mpi_cuda_largescaleknn_tpu.models.unordered import UnorderedKNN
+from mpi_cuda_largescaleknn_tpu.obs.trace import profile_trace
+from mpi_cuda_largescaleknn_tpu.parallel.mesh import AXIS, get_mesh
+
+
+def main(argv: list[str] | None = None) -> int:
+    cfg, in_path, out_path, extras = parse_args(
+        "tpuknn-unordered", sys.argv[1:] if argv is None else argv)
+
+    mesh = get_mesh(extras["shards"])
+    points, _begin, n_total = read_file_portion(in_path, 0, 1)
+    print(f"# mesh of {mesh.shape[AXIS]} device(s): "
+          f"got {n_total} points to work on")
+
+    model = UnorderedKNN(cfg, mesh=mesh)
+    with profile_trace(cfg.profile_dir):
+        dists = model.run(points)
+    write_distances(out_path, dists)
+    print("done all queries...")
+    if extras["timings"]:
+        sys.stderr.write(model.timers.dump() + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

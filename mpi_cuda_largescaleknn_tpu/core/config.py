@@ -28,7 +28,6 @@ class KnnConfig:
     query_tile: int = 2048           # queries processed per inner tile
     point_tile: int = 2048           # tree points per inner tile
     num_shards: int = 1              # size of the 1-D mesh axis
-    checkpoint_dir: str | None = None  # save heap state every round if set
     profile_dir: str | None = None   # jax.profiler trace output
     verbose: bool = False
 

@@ -1,0 +1,9 @@
+from mpi_cuda_largescaleknn_tpu.io.reader import (  # noqa: F401
+    read_file_portion,
+    read_list_of_file_names,
+    read_points,
+)
+from mpi_cuda_largescaleknn_tpu.io.writer import (  # noqa: F401
+    write_distances,
+    write_rank_file,
+)

@@ -1,0 +1,75 @@
+"""ctypes binding to the native IO library (io/native_io.cpp).
+
+Compiled on first use with g++ (cached next to the source); every entry point
+has a pure-numpy fallback in io/reader.py / io/writer.py, so a missing
+toolchain only costs speed, never correctness.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(__file__)
+_SRC = os.path.join(_HERE, "native_io.cpp")
+_LIB_PATH = os.path.join(_HERE, "_native_io.so")
+_lock = threading.Lock()
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if (not os.path.exists(_LIB_PATH)
+                or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)):
+            # compile to a private temp path then atomically rename, so a
+            # concurrent process can never dlopen a half-written library
+            tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-pthread",
+                 "-o", tmp, _SRC],
+                check=True, capture_output=True)
+            os.replace(tmp, _LIB_PATH)
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.lsk_read_at.restype = ctypes.c_int64
+        lib.lsk_read_at.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                    ctypes.c_int64, ctypes.c_void_p,
+                                    ctypes.c_int32]
+        lib.lsk_write_at.restype = ctypes.c_int64
+        lib.lsk_write_at.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                     ctypes.c_int64, ctypes.c_void_p]
+        lib.lsk_file_size.restype = ctypes.c_int64
+        lib.lsk_file_size.argtypes = [ctypes.c_char_p]
+        _lib = lib
+    return _lib
+
+
+def native_read_slab(path: str, begin_record: int, num_records: int,
+                     num_threads: int = 8) -> np.ndarray:
+    """Read ``num_records`` float3 records starting at ``begin_record``."""
+    lib = _load()
+    out = np.empty((num_records, 3), np.float32)
+    nbytes = num_records * 12
+    got = lib.lsk_read_at(path.encode(), begin_record * 12, nbytes,
+                          out.ctypes.data_as(ctypes.c_void_p), num_threads)
+    if got != nbytes:
+        raise IOError(f"native read of {path} returned {got} != {nbytes}")
+    return out
+
+
+def native_write_at(path: str, offset_bytes: int, data: np.ndarray) -> None:
+    """Positioned write (concurrent-writer-safe at disjoint offsets)."""
+    lib = _load()
+    data = np.ascontiguousarray(data)
+    put = lib.lsk_write_at(path.encode(), offset_bytes, data.nbytes,
+                           data.ctypes.data_as(ctypes.c_void_p))
+    if put != data.nbytes:
+        raise IOError(f"native write of {path} returned {put} != {data.nbytes}")

@@ -1,0 +1,59 @@
+"""Binary point-file input.
+
+File formats are byte-compatible with the reference:
+
+- ``.float3`` input: raw little-endian f32 triples, no header
+  (``readFilePortion<float3>``, unorderedDataVariant.cu:41-63).
+- file-of-filenames: one path per line (``readListOfFileNames``,
+  prePartitionedDataVariant.cu:114-126). The reference drops the last line
+  when the file lacks a trailing newline (SURVEY.md appendix) — that is a
+  latent bug, not a contract; we read every non-empty line.
+
+A native C++ fast path (pread, parallel slabs) is used when available — see
+io/native.py; the numpy fallback is always correct.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+_RECORD_BYTES = 12  # one float3
+
+
+def read_file_portion(path: str, rank: int, size: int):
+    """Read shard ``rank`` of ``size``'s contiguous slab of a ``.float3`` file.
+
+    Slab bounds are ``numData*rank/size .. numData*(rank+1)/size`` — the exact
+    integer arithmetic of the reference (unorderedDataVariant.cu:55-57), so
+    global output ordering matches byte-for-byte.
+
+    Returns (points f32[n,3], begin, num_total).
+    """
+    num_bytes = os.path.getsize(path)
+    num_data = num_bytes // _RECORD_BYTES
+    begin = num_data * rank // size
+    end = num_data * (rank + 1) // size
+    try:
+        from mpi_cuda_largescaleknn_tpu.io.native import native_read_slab
+
+        pts = native_read_slab(path, begin, end - begin)
+    except Exception:
+        with open(path, "rb") as f:
+            f.seek(begin * _RECORD_BYTES)
+            pts = np.fromfile(f, dtype=np.float32, count=(end - begin) * 3)
+        pts = pts.reshape(-1, 3)
+    return pts, begin, num_data
+
+
+def read_points(path: str) -> np.ndarray:
+    """Whole-file read (the prepartitioned variant's per-rank
+    ``readFilePortion(..., 0, 1)``, prePartitionedDataVariant.cu:228-229)."""
+    pts, _, _ = read_file_portion(path, 0, 1)
+    return pts
+
+
+def read_list_of_file_names(path: str) -> list[str]:
+    with open(path) as f:
+        return [line.strip() for line in f if line.strip()]

@@ -1,0 +1,26 @@
+"""Binary distance-file output.
+
+- unordered variant: ONE ``.float`` file holding every point's k-th-NN
+  distance in global point order. The reference produces this with R
+  barrier-fenced sequential appends, one rank at a time
+  (unorderedDataVariant.cu:229-237); here the results are already gathered in
+  rank order, so it's a single write (on a multi-host pod each host pwrites
+  its slab at its byte offset — no serialization needed, see io/native.py).
+- prepartitioned variant: one ``prefix_%06d.float`` file per shard
+  (prePartitionedDataVariant.cu:380-385).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def write_distances(path: str, distances: np.ndarray) -> None:
+    np.asarray(distances, np.float32).tofile(path)
+
+
+def write_rank_file(prefix: str, rank: int, distances: np.ndarray) -> str:
+    """Write one shard's results as ``<prefix>_%06d.float``."""
+    path = f"{prefix}_{rank:06d}.float"
+    np.asarray(distances, np.float32).tofile(path)
+    return path

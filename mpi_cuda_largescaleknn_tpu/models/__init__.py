@@ -1,0 +1,2 @@
+from mpi_cuda_largescaleknn_tpu.models.unordered import UnorderedKNN  # noqa: F401
+from mpi_cuda_largescaleknn_tpu.models.prepartitioned import PrePartitionedKNN  # noqa: F401

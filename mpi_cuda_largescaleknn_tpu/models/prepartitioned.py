@@ -1,0 +1,61 @@
+"""The pre-partitioned pipeline: one spatially-coherent shard per device.
+
+End-to-end equivalent of ``cudaMpiKNN_prePartitionedData``'s main()
+(prePartitionedDataVariant.cu:176-389): each device owns one input partition
+(the reference: one file per rank, asserted at :215-216), shards are padded to
+the global max count (:251-266), and the bounds-pruned early-exit engine
+refines every partition's heaps until no device can improve. Results come
+back per-partition (the reference writes one ``prefix_%06d.float`` per rank,
+:380-385).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mpi_cuda_largescaleknn_tpu.core.config import KnnConfig
+from mpi_cuda_largescaleknn_tpu.models.sharding import pad_and_flatten, trim_per_shard
+from mpi_cuda_largescaleknn_tpu.obs.timers import PhaseTimers
+from mpi_cuda_largescaleknn_tpu.parallel.demand import demand_knn
+from mpi_cuda_largescaleknn_tpu.parallel.mesh import AXIS, get_mesh
+
+
+class PrePartitionedKNN:
+    """kNN distances for pre-partitioned point sets over a 1-D mesh."""
+
+    def __init__(self, config: KnnConfig, mesh=None):
+        config.validate()
+        self.config = config
+        self.mesh = mesh if mesh is not None else get_mesh(
+            config.num_shards if config.num_shards > 0 else None)
+        self.timers = PhaseTimers()
+        self.last_stats: dict | None = None
+
+    def run(self, partitions: list[np.ndarray]) -> list[np.ndarray]:
+        """partitions: one f32[Ni,3] array per device -> per-partition f32[Ni]
+        k-th-NN distances (global over the union of all partitions)."""
+        cfg = self.config
+        num_shards = self.mesh.shape[AXIS]
+        if len(partitions) != num_shards:
+            # the reference's "number of input files does not match MPI size"
+            # (prePartitionedDataVariant.cu:215-216)
+            raise ValueError(
+                f"number of input partitions ({len(partitions)}) does not "
+                f"match mesh size ({num_shards})")
+
+        with self.timers.phase("pad"):
+            flat, ids, counts, npad = pad_and_flatten(partitions)
+
+        with self.timers.phase("demand_ring"):
+            dists, _cands, stats = demand_knn(
+                flat, ids, cfg.k, self.mesh, max_radius=cfg.max_radius,
+                engine=cfg.engine, query_tile=cfg.query_tile,
+                point_tile=cfg.point_tile, return_stats=True)
+            dists = np.asarray(dists)
+            self.last_stats = {
+                "rounds": int(np.asarray(stats["rounds"])[0]),
+                "kernels_run": np.asarray(stats["kernels_run"]).tolist(),
+            }
+
+        with self.timers.phase("extract"):
+            return trim_per_shard(dists, counts, npad)

@@ -1,0 +1,46 @@
+"""Host-side shard layout helpers shared by both pipelines.
+
+Slab semantics follow the reference's ``readFilePortion`` exactly
+(unorderedDataVariant.cu:41-63): shard r of R owns rows
+``[N*r/R, N*(r+1)/R)`` of the global array (sizes differ by at most one), so
+concatenating per-shard results in rank order reproduces the reference's
+single-output-file byte layout (unorderedDataVariant.cu:229-237).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mpi_cuda_largescaleknn_tpu.core.types import PAD_SENTINEL
+
+
+def slab_bounds(num_total: int, num_shards: int) -> list[tuple[int, int]]:
+    return [(num_total * r // num_shards, num_total * (r + 1) // num_shards)
+            for r in range(num_shards)]
+
+
+def pad_and_flatten(shards: list[np.ndarray], id_bases: list[int] | None = None,
+                    pad_to: int | None = None):
+    """Pack per-shard point arrays into the engines' shard-major layout.
+
+    Returns (points f32[R*Npad,3], ids i32[R*Npad], counts [R], Npad) where
+    Npad = max shard size (the prepartitioned variant's pad-to-max,
+    prePartitionedDataVariant.cu:251-266), padding rows = PAD_SENTINEL / id -1.
+    ``id_bases[r]`` is shard r's global index offset (slab begin).
+    """
+    num_shards = len(shards)
+    counts = [len(s) for s in shards]
+    npad = max(max(counts), 1) if pad_to is None else pad_to
+    assert npad >= max(counts)
+    points = np.full((num_shards * npad, 3), PAD_SENTINEL, np.float32)
+    ids = np.full(num_shards * npad, -1, np.int32)
+    for r, s in enumerate(shards):
+        points[r * npad:r * npad + counts[r]] = np.asarray(s, np.float32)
+        base = id_bases[r] if id_bases is not None else 0
+        ids[r * npad:r * npad + counts[r]] = base + np.arange(counts[r], dtype=np.int32)
+    return points, ids, counts, npad
+
+
+def trim_per_shard(flat: np.ndarray, counts: list[int], npad: int) -> list[np.ndarray]:
+    """Undo the padding: per-shard arrays of true length."""
+    return [np.asarray(flat[r * npad:r * npad + c]) for r, c in enumerate(counts)]

@@ -1,0 +1,2 @@
+from mpi_cuda_largescaleknn_tpu.obs.timers import PhaseTimers  # noqa: F401
+from mpi_cuda_largescaleknn_tpu.obs.trace import profile_trace  # noqa: F401

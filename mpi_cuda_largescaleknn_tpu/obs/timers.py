@@ -1,0 +1,49 @@
+"""Phase timers + bandwidth counters.
+
+The reference never measures itself (SURVEY.md §5: no timers anywhere, stdout
+progress lines only) — this subsystem is the capability the TPU build adds so
+BASELINE numbers can be produced at all. Wall-clock per phase, optional bytes
+moved (for cross-shard exchange bandwidth), queries/sec derivation.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class PhaseRecord:
+    seconds: float = 0.0
+    calls: int = 0
+    bytes_moved: int = 0
+
+    @property
+    def gb_per_sec(self) -> float:
+        return (self.bytes_moved / self.seconds / 1e9) if self.seconds else 0.0
+
+
+@dataclass
+class PhaseTimers:
+    phases: dict[str, PhaseRecord] = field(default_factory=dict)
+
+    @contextlib.contextmanager
+    def phase(self, name: str, bytes_moved: int = 0):
+        rec = self.phases.setdefault(name, PhaseRecord())
+        t0 = time.perf_counter()
+        try:
+            yield rec
+        finally:
+            rec.seconds += time.perf_counter() - t0
+            rec.calls += 1
+            rec.bytes_moved += bytes_moved
+
+    def report(self) -> dict:
+        return {name: {"seconds": round(r.seconds, 6), "calls": r.calls,
+                       **({"GB/s": round(r.gb_per_sec, 3)} if r.bytes_moved else {})}
+                for name, r in self.phases.items()}
+
+    def dump(self) -> str:
+        return json.dumps(self.report())

@@ -1,0 +1,17 @@
+"""jax.profiler integration (opt-in, see KnnConfig.profile_dir)."""
+
+from __future__ import annotations
+
+import contextlib
+
+
+@contextlib.contextmanager
+def profile_trace(profile_dir: str | None):
+    """Wrap a region in a jax.profiler trace when a directory is given."""
+    if not profile_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(profile_dir):
+        yield

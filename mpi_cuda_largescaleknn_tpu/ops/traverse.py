@@ -100,8 +100,11 @@ def knn_update_tree(state: CandidateState, queries: jnp.ndarray,
         new_curr = jnp.where(active, nxt, curr)
         return new_curr, new_prev, hd2, hidx
 
-    curr0 = jnp.zeros((num_q,), jnp.int32)
-    prev0 = jnp.full((num_q,), -1, jnp.int32)
+    # derive loop state from an input so it inherits the caller's
+    # device-varying type under shard_map (a fresh constant would not)
+    zero = state.idx[:, 0] * 0
+    curr0 = zero
+    prev0 = zero - 1
     curr, prev, hd2, hidx = jax.lax.while_loop(
         cond, body, (curr0, prev0, state.dist2, state.idx))
     return CandidateState(hd2, hidx)

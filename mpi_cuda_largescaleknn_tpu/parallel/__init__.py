@@ -1,0 +1,7 @@
+from mpi_cuda_largescaleknn_tpu.parallel.mesh import (  # noqa: F401
+    get_mesh,
+    initialize_distributed,
+    shard_axis_size,
+)
+from mpi_cuda_largescaleknn_tpu.parallel.ring import ring_knn  # noqa: F401
+from mpi_cuda_largescaleknn_tpu.parallel.demand import demand_knn  # noqa: F401

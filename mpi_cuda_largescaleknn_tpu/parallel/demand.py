@@ -1,0 +1,150 @@
+"""Bounds-pruned early-exit engine — the prepartitioned variant's core.
+
+The reference (prePartitionedDataVariant.cu:304-357) runs, per round: each
+rank picks the *nearest not-yet-seen* peer whose bounding box is closer than
+its current worst k-th-NN distance (``computeMyPeer``, :157-174), Allgathers
+the picks, exits globally when every pick is -1 (:320-322), then transfers
+trees point-to-point (one Irecv, fan-out Isends, :324-345) and re-queries.
+The win on spatially-coherent data: most ranks stop after visiting a handful
+of neighbors instead of all R.
+
+TPU-native re-design (NOT a translation of the MPI matching): data-dependent
+point-to-point routing does not exist under XLA's static SPMD model — and on
+an ICI torus it buys little, because a neighbor ``ppermute`` is cheap and
+overlaps with compute. What actually costs time is the *query kernel*. So:
+
+- shards rotate on the same static ring as parallel/ring.py;
+- each device *skips the kernel* (``lax.cond``) for any arriving shard whose
+  bounding box is at least its current worst radius away — the same prune
+  predicate as ``computeMyPeer`` (box-distance >= cutoff, :168), evaluated
+  against ``all_gather``-ed bounds (the reference Allgathers bounds the same
+  way, :290-291);
+- the whole loop is a ``lax.while_loop`` whose continue flag is a ``pmax``
+  over "does any device still need any unseen shard" — the global early exit
+  (:320-322) without a host round-trip;
+- the per-query worst-radius reduction that the reference maintains with a
+  managed-memory float + ``cukd::atomicMax`` (:91-94, :297-298) is a masked
+  ``jnp.max`` over the candidate state each round.
+
+Trade-off vs the reference, stated honestly: the reference visits peers
+nearest-first (tightening the prune radius fastest) and can stop after its
+*own* needs are met; the ring visits in fixed order and runs until the
+*slowest* device is done, but pays only a skipped-kernel's cost (~0) for
+unneeded shards and keeps every transfer on neighbor ICI links instead of
+arbitrary point-to-point routes. For the reference's own early-exit-friendly
+regime (spatially pre-partitioned files, README.md:17-23) both stop after
+max-over-ranks(#needed-peers) rounds.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mpi_cuda_largescaleknn_tpu.core.types import (
+    PAD_SENTINEL,
+    CandidateState,
+    aabb_box_distance,
+    aabb_of_points,
+)
+from mpi_cuda_largescaleknn_tpu.ops.build_tree import build_tree
+from mpi_cuda_largescaleknn_tpu.ops.candidates import (
+    current_worst_radius,
+    extract_final_result,
+    init_candidates,
+)
+from mpi_cuda_largescaleknn_tpu.parallel.mesh import AXIS, pvary
+from mpi_cuda_largescaleknn_tpu.parallel.ring import _engine_fn
+
+
+def demand_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
+               mesh, *, max_radius: float = jnp.inf,
+               engine: str = "bruteforce", query_tile: int = 2048,
+               point_tile: int = 2048, return_stats: bool = False):
+    """Bounds-pruned kNN over pre-partitioned shards on a 1-D mesh.
+
+    Same data contract as ring_knn (shard-major padded rows); additionally
+    returns, when ``return_stats``, the number of rounds executed and the
+    per-device count of query kernels actually run — the observability the
+    reference only exposes as per-round stdout prints (:306).
+    """
+    num_shards = mesh.shape[AXIS]
+    update = _engine_fn(engine, query_tile, point_tile)
+    use_tree = engine == "tree"
+    fwd = [(i, (i + 1) % num_shards) for i in range(num_shards)]
+
+    def body(pts_local, ids_local):
+        me = jax.lax.axis_index(AXIS)
+        queries = pts_local
+        valid = pts_local[:, 0] < PAD_SENTINEL / 2
+        if use_tree:
+            shard, shard_ids = build_tree(pts_local, ids_local)
+        else:
+            shard, shard_ids = pts_local, ids_local
+
+        # bounds of every shard's real points, replicated to all devices
+        # (the reference's Allgather of 6-float boxes, :290-291)
+        box = aabb_of_points(pts_local, valid)
+        all_lower = jax.lax.all_gather(box.lower, AXIS)   # [R, 3]
+        all_upper = jax.lax.all_gather(box.upper, AXIS)
+        # min distance from MY queries' box to every shard's box
+        box_dist = aabb_box_distance(box.lower[None, :], box.upper[None, :],
+                                     all_lower, all_upper)  # [R]
+        # shard s arrives at this device in round (me - s) mod R
+        arrival_round = jnp.mod(me - jnp.arange(num_shards), num_shards)
+
+        heap = pvary(init_candidates(queries.shape[0], k, max_radius))
+
+        def cond(carry):
+            _shard, _ids, _hd2, _hidx, rnd, keep_going, _nrun = carry
+            return (rnd < num_shards) & keep_going
+
+        def round_body(carry):
+            shard, shard_ids, hd2, hidx, rnd, _kg, nrun = carry
+            nxt = jax.lax.ppermute(shard, AXIS, fwd)
+            nxt_ids = jax.lax.ppermute(shard_ids, AXIS, fwd)
+
+            cur_radius = current_worst_radius(CandidateState(hd2, hidx), valid)
+            src = jnp.mod(me - rnd, num_shards)
+            # visit iff the resident shard's box is strictly closer than the
+            # current worst k-th distance (computeMyPeer's prune, :168);
+            # round 0 is the own shard at distance 0
+            do_visit = jax.lax.dynamic_index_in_dim(
+                box_dist, src, keepdims=False) < cur_radius
+
+            def run(_):
+                st = update(CandidateState(hd2, hidx), queries, shard, shard_ids)
+                return st.dist2, st.idx
+
+            hd2, hidx = jax.lax.cond(do_visit, run, lambda _: (hd2, hidx), None)
+            nrun = nrun + do_visit.astype(jnp.int32)
+
+            # global early exit: does ANY device still need ANY unseen shard?
+            new_radius = current_worst_radius(CandidateState(hd2, hidx), valid)
+            i_need_more = jnp.any((arrival_round > rnd) & (box_dist < new_radius))
+            keep_going = jax.lax.pmax(i_need_more.astype(jnp.int32), AXIS) > 0
+            return nxt, nxt_ids, hd2, hidx, rnd + 1, keep_going, nrun
+
+        # rnd and keep_going are uniform across devices (keep_going is a pmax
+        # reduction, hence replicated); nrun is per-device
+        init = (shard, shard_ids, heap.dist2, heap.idx,
+                jnp.int32(0), jnp.bool_(True), pvary(jnp.int32(0)))
+        _, _, hd2, hidx, rounds, _, nrun = jax.lax.while_loop(cond, round_body, init)
+        heap = CandidateState(hd2, hidx)
+        return (extract_final_result(heap), hd2, hidx,
+                pvary(rounds)[None], nrun[None])
+
+    spec = P(AXIS)
+    mapped = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec),
+        out_specs=(spec, spec, spec, spec, spec)))
+
+    sharding = NamedSharding(mesh, spec)
+    points_sharded = jax.device_put(points_sharded, sharding)
+    ids_sharded = jax.device_put(ids_sharded, sharding)
+    dists, hd2, hidx, rounds, nrun = mapped(points_sharded, ids_sharded)
+    if return_stats:
+        return dists, CandidateState(hd2, hidx), {
+            "rounds": rounds, "kernels_run": nrun}
+    return dists

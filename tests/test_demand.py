@@ -1,0 +1,85 @@
+import numpy as np
+import pytest
+
+from mpi_cuda_largescaleknn_tpu.core.config import KnnConfig
+from mpi_cuda_largescaleknn_tpu.models.prepartitioned import PrePartitionedKNN
+from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+
+from .oracle import assert_dist_equal, kth_nn_dist, random_points
+
+
+def _cfg(**kw):
+    kw.setdefault("k", 8)
+    kw.setdefault("query_tile", 128)
+    kw.setdefault("point_tile", 128)
+    return KnnConfig(**kw)
+
+
+def _tiled_partitions(num, n_each, gap=10.0, seed=0):
+    """Spatially separated clusters: cluster i lives at x-offset i*gap."""
+    out = []
+    for i in range(num):
+        p = random_points(n_each, seed=seed + i)
+        p[:, 0] += i * gap
+        out.append(p)
+    return out
+
+
+def test_demand_matches_oracle_overlapping():
+    # partitions drawn from the same unit cube: everyone needs everyone
+    parts = [random_points(120, seed=10 + i) for i in range(8)]
+    model = PrePartitionedKNN(_cfg(), mesh=get_mesh(8))
+    got = model.run(parts)
+    allp = np.concatenate(parts)
+    for part, d in zip(parts, got):
+        assert_dist_equal(d, kth_nn_dist(part, allp, 8))
+
+
+def test_demand_early_exit_on_tiled_data():
+    # far-separated clusters: after round 0 every heap is full with local
+    # neighbors and every other shard's box is beyond the worst radius ->
+    # the while_loop exits after round 1 (the reference's all-picks-are--1
+    # global exit, prePartitionedDataVariant.cu:320-322)
+    parts = _tiled_partitions(8, 100)
+    model = PrePartitionedKNN(_cfg(k=4), mesh=get_mesh(8))
+    got = model.run(parts)
+    assert model.last_stats["rounds"] < 8, model.last_stats
+    assert model.last_stats["kernels_run"] == [1] * 8
+    allp = np.concatenate(parts)
+    for part, d in zip(parts, got):
+        assert_dist_equal(d, kth_nn_dist(part, allp, 4))
+
+
+def test_demand_uneven_and_empty_partitions():
+    parts = [random_points(50, seed=20), np.zeros((0, 3), np.float32),
+             random_points(75, seed=21), random_points(10, seed=22)]
+    model = PrePartitionedKNN(_cfg(k=5), mesh=get_mesh(4))
+    got = model.run(parts)
+    allp = np.concatenate(parts)
+    assert got[1].shape == (0,)
+    for part, d in zip(parts, got):
+        if len(part):
+            assert_dist_equal(d, kth_nn_dist(part, allp, 5))
+
+
+def test_demand_partition_count_mismatch():
+    with pytest.raises(ValueError, match="does not match mesh size"):
+        PrePartitionedKNN(_cfg(), mesh=get_mesh(4)).run(
+            [random_points(10)] * 3)
+
+
+def test_demand_tree_engine():
+    parts = [random_points(80, seed=30 + i) for i in range(4)]
+    got = PrePartitionedKNN(_cfg(engine="tree"), mesh=get_mesh(4)).run(parts)
+    allp = np.concatenate(parts)
+    for part, d in zip(parts, got):
+        assert_dist_equal(d, kth_nn_dist(part, allp, 8))
+
+
+def test_demand_radius_semantics():
+    parts = _tiled_partitions(4, 60, gap=5.0, seed=40)
+    r = 0.25
+    got = PrePartitionedKNN(_cfg(k=30, max_radius=r), mesh=get_mesh(4)).run(parts)
+    allp = np.concatenate(parts)
+    for part, d in zip(parts, got):
+        assert_dist_equal(d, kth_nn_dist(part, allp, 30, max_radius=r))

@@ -1,0 +1,106 @@
+import numpy as np
+import pytest
+
+from mpi_cuda_largescaleknn_tpu.cli.prepartitioned_main import main as prepart_main
+from mpi_cuda_largescaleknn_tpu.cli.unordered_main import main as unordered_main
+from mpi_cuda_largescaleknn_tpu.io.native import native_read_slab, native_write_at
+from mpi_cuda_largescaleknn_tpu.io.reader import (
+    read_file_portion,
+    read_list_of_file_names,
+)
+
+from .oracle import assert_dist_equal, kth_nn_dist, random_points
+
+
+def test_read_file_portion_slab_semantics(tmp_path):
+    pts = random_points(101, seed=1)
+    path = tmp_path / "pts.float3"
+    pts.tofile(path)
+    slabs = []
+    for r in range(4):
+        slab, begin, total = read_file_portion(str(path), r, 4)
+        assert total == 101
+        assert begin == 101 * r // 4  # the reference's integer slab bounds
+        slabs.append(slab)
+    np.testing.assert_array_equal(np.concatenate(slabs), pts)
+
+
+def test_read_list_of_file_names(tmp_path):
+    p = tmp_path / "list.txt"
+    p.write_text("a.bin\nb.bin\nc.bin")  # no trailing newline
+    assert read_list_of_file_names(str(p)) == ["a.bin", "b.bin", "c.bin"]
+
+
+def test_native_io_roundtrip(tmp_path):
+    if not __import__("shutil").which("g++"):
+        pytest.skip("no C++ toolchain; numpy fallback covers correctness")
+    pts = random_points(64, seed=2)
+    path = str(tmp_path / "n.float3")
+    pts.tofile(path)
+    mid = native_read_slab(path, 16, 32)
+    np.testing.assert_array_equal(mid, pts[16:48])
+    out_path = str(tmp_path / "w.float")
+    native_write_at(out_path, 0, pts[:8])
+    native_write_at(out_path, 8 * 12, pts[8:16])
+    np.testing.assert_array_equal(
+        np.fromfile(out_path, np.float32).reshape(-1, 3), pts[:16])
+
+
+def test_unordered_cli_end_to_end(tmp_path):
+    pts = random_points(300, seed=3)
+    in_path = str(tmp_path / "in.float3")
+    out_path = str(tmp_path / "out.float")
+    pts.tofile(in_path)
+    rc = unordered_main([in_path, "-o", out_path, "-k", "4", "--shards", "4",
+                         "--query-tile", "64", "--point-tile", "64"])
+    assert rc == 0
+    got = np.fromfile(out_path, np.float32)
+    assert got.shape == (300,)
+    assert_dist_equal(got, kth_nn_dist(pts, pts, 4))
+
+
+def test_prepartitioned_cli_end_to_end(tmp_path):
+    parts = [random_points(80, seed=10 + i) for i in range(3)]
+    names = []
+    for i, p in enumerate(parts):
+        f = str(tmp_path / f"part{i}.float3")
+        p.tofile(f)
+        names.append(f)
+    list_path = str(tmp_path / "files.txt")
+    with open(list_path, "w") as f:
+        f.write("\n".join(names) + "\n")
+    prefix = str(tmp_path / "dists")
+    rc = prepart_main([list_path, "-k", "5", "-o", prefix,
+                       "--query-tile", "64", "--point-tile", "64"])
+    assert rc == 0
+    allp = np.concatenate(parts)
+    for i, p in enumerate(parts):
+        got = np.fromfile(f"{prefix}_{i:06d}.float", np.float32)
+        assert_dist_equal(got, kth_nn_dist(p, allp, 5))
+
+
+def test_cli_rejects_missing_k(tmp_path, capsys):
+    with pytest.raises(SystemExit) as e:
+        unordered_main(["in.float3", "-o", "out.float"])
+    assert e.value.code == 1
+    assert "no k specified" in capsys.readouterr().err
+
+
+def test_cli_rejects_unknown_flag(capsys):
+    with pytest.raises(SystemExit) as e:
+        unordered_main(["-q", "bogus"])
+    assert e.value.code == 1
+    assert "unknown cmdline arg" in capsys.readouterr().err
+
+
+def test_cli_radius_flag(tmp_path):
+    pts = random_points(150, seed=4)
+    in_path = str(tmp_path / "in.float3")
+    out_path = str(tmp_path / "out.float")
+    pts.tofile(in_path)
+    rc = unordered_main([in_path, "-o", out_path, "-k", "10", "-r", "0.05",
+                         "--shards", "2", "--query-tile", "64",
+                         "--point-tile", "64"])
+    assert rc == 0
+    got = np.fromfile(out_path, np.float32)
+    assert_dist_equal(got, kth_nn_dist(pts, pts, 10, max_radius=0.05))
