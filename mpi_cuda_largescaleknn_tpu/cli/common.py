@@ -18,9 +18,11 @@ from mpi_cuda_largescaleknn_tpu.core.config import KnnConfig
 TPU_FLAGS = """
 TPU-side options (no reference analogue):
   --shards N        size of the 1-D device mesh (default: all devices)
-  --engine E        bruteforce | tree | pallas | auto (default auto)
-  --query-tile N    queries per inner tile (default 2048)
-  --point-tile N    tree points per inner tile (default 2048)
+  --engine E        tiled | bruteforce | tree | pallas | auto (default
+                    auto = tiled, the bucketed nearest-first engine)
+  --query-tile N    queries per inner tile (flat engines; default 2048)
+  --point-tile N    tree points per inner tile (flat engines; default 2048)
+  --bucket-size N   points per spatial bucket (tiled engine; default 512)
   --profile-dir D   write a jax.profiler trace
   --timings         print phase timings as JSON to stderr
 """
@@ -41,7 +43,8 @@ def parse_args(program: str, argv: list[str]):
     in_path = ""
     out_path = ""
     extras = {"shards": None, "engine": "auto", "query_tile": 2048,
-              "point_tile": 2048, "profile_dir": None, "timings": False}
+              "point_tile": 2048, "bucket_size": 512, "profile_dir": None,
+              "timings": False}
     i = 0
     try:
         while i < len(argv):
@@ -64,6 +67,8 @@ def parse_args(program: str, argv: list[str]):
                 i += 1; extras["query_tile"] = int(argv[i])
             elif arg == "--point-tile":
                 i += 1; extras["point_tile"] = int(argv[i])
+            elif arg == "--bucket-size":
+                i += 1; extras["bucket_size"] = int(argv[i])
             elif arg == "--profile-dir":
                 i += 1; extras["profile_dir"] = argv[i]
             elif arg == "--timings":
@@ -84,6 +89,7 @@ def parse_args(program: str, argv: list[str]):
     cfg = KnnConfig(k=k, max_radius=max_radius, device_affinity=affinity,
                     engine=extras["engine"], query_tile=extras["query_tile"],
                     point_tile=extras["point_tile"],
+                    bucket_size=extras["bucket_size"],
                     num_shards=extras["shards"] or 0,
                     profile_dir=extras["profile_dir"])
     return cfg, in_path, out_path, extras

@@ -24,9 +24,11 @@ class KnnConfig:
                                      # the runtime owns device binding)
 
     # --- TPU-side knobs ----------------------------------------------------
-    engine: str = "auto"             # "auto" | "bruteforce" | "tree" | "pallas"
+    engine: str = "auto"             # "auto" (= tiled) | "tiled" | "bruteforce"
+                                     # | "tree" | "pallas"
     query_tile: int = 2048           # queries processed per inner tile
     point_tile: int = 2048           # tree points per inner tile
+    bucket_size: int = 512           # tiled engine: points per spatial bucket
     num_shards: int = 1              # size of the 1-D mesh axis
     profile_dir: str | None = None   # jax.profiler trace output
     verbose: bool = False
@@ -34,5 +36,5 @@ class KnnConfig:
     def validate(self) -> None:
         if self.k < 1:
             raise ValueError("no k specified, or invalid k value")
-        if self.engine not in ("auto", "bruteforce", "tree", "pallas"):
+        if self.engine not in ("auto", "tiled", "bruteforce", "tree", "pallas"):
             raise ValueError(f"unknown engine '{self.engine}'")

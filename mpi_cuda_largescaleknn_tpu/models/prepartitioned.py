@@ -50,7 +50,8 @@ class PrePartitionedKNN:
             dists, _cands, stats = demand_knn(
                 flat, ids, cfg.k, self.mesh, max_radius=cfg.max_radius,
                 engine=cfg.engine, query_tile=cfg.query_tile,
-                point_tile=cfg.point_tile, return_stats=True)
+                point_tile=cfg.point_tile, bucket_size=cfg.bucket_size,
+                return_stats=True)
             dists = np.asarray(dists)
             self.last_stats = {
                 "rounds": int(np.asarray(stats["rounds"])[0]),

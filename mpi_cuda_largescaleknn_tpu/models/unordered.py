@@ -50,7 +50,7 @@ class UnorderedKNN:
             dists = ring_knn(
                 flat, ids, cfg.k, self.mesh, max_radius=cfg.max_radius,
                 engine=cfg.engine, query_tile=cfg.query_tile,
-                point_tile=cfg.point_tile)
+                point_tile=cfg.point_tile, bucket_size=cfg.bucket_size)
             dists = np.asarray(dists)
 
         with self.timers.phase("extract"):

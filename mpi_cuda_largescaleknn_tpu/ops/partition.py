@@ -1,0 +1,148 @@
+"""Spatial bucketing: median-split partition into contiguous leaf tiles.
+
+This is the top of the kd-tree re-expressed for a tile machine. The
+reference's per-point implicit tree (``cukd::buildTree``,
+unorderedDataVariant.cu:161) exists so one scalar GPU thread can walk
+point-by-point; a TPU wants *tile*-granular structure instead: the point set
+is recursively median-split (widest-extent dimension, L levels of one
+``lax.sort`` each — the same sort-dominated complexity class as the GPU
+builder, arXiv:2211.00120) into ``B = 2^L`` equal-size contiguous buckets,
+each with a tight AABB over its real points. The bucketed array plus bounds
+IS the tree: traversal becomes "visit buckets nearest-first, prune on box
+distance" (ops/tiled.py), which is the same pruning predicate the
+reference's traversal applies per node and its demand engine applies per
+rank (``computeDistance``/``computeMyPeer``, prePartitionedDataVariant.cu:
+150-174) — evaluated at VPU-tile granularity.
+
+Sentinel padding rows (PAD_SENTINEL coords) sort above every real
+coordinate, so they accumulate in the trailing buckets; AABBs mask them out,
+leaving empty buckets with inverted (+inf/-inf) bounds that any box-distance
+computation reports as infinitely far — never visited.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from mpi_cuda_largescaleknn_tpu.core.types import PAD_SENTINEL
+from mpi_cuda_largescaleknn_tpu.utils.math import cdiv, next_pow2, round_up
+
+
+class BucketedPoints(NamedTuple):
+    """A point shard in bucket-contiguous order plus its per-bucket bounds.
+
+    ``pts`` rows within one bucket are spatially coherent; ``ids`` carry
+    global point identities (-1 = padding); ``pos`` maps each bucketed row
+    back to its row in the *input* array (-1 = padding) so results computed
+    in bucket order can be scattered back.
+    """
+
+    pts: jnp.ndarray    # f32[B, S, 3]
+    ids: jnp.ndarray    # i32[B, S]
+    lower: jnp.ndarray  # f32[B, 3] (+inf rows for empty buckets)
+    upper: jnp.ndarray  # f32[B, 3] (-inf rows for empty buckets)
+    pos: jnp.ndarray    # i32[B, S] row index into the input array, -1 = pad
+
+    @property
+    def num_buckets(self) -> int:
+        return self.pts.shape[0]
+
+    @property
+    def bucket_size(self) -> int:
+        return self.pts.shape[1]
+
+
+def choose_buckets(n: int, bucket_size_target: int) -> tuple[int, int]:
+    """(B, S): B = power-of-two bucket count, S = padded bucket size
+    (multiple of 8 sublanes) with B*S >= n and S close to the target."""
+    b = next_pow2(max(1, cdiv(n, max(bucket_size_target, 1))))
+    s = round_up(max(cdiv(n, b), 1), 8)
+    return b, s
+
+
+def partition_points(points: jnp.ndarray, point_ids: jnp.ndarray | None = None,
+                     *, bucket_size: int = 512) -> BucketedPoints:
+    """Partition ``f32[N,3]`` into ``B`` contiguous median-split buckets.
+
+    Each of the ``log2 B`` levels is one stable multi-operand ``lax.sort``
+    keyed by (segment-id, coordinate along the segment's widest extent) —
+    segments are equal-size contiguous ranges, so segment ids are static
+    ``iota // seg_size`` arrays and per-segment extents are plain reshaped
+    min/max reductions. No scalar loops, fully jittable, static shapes.
+    """
+    points = jnp.asarray(points, jnp.float32)
+    n = points.shape[0]
+    if point_ids is None:
+        point_ids = jnp.arange(n, dtype=jnp.int32)
+    point_ids = jnp.asarray(point_ids, jnp.int32)
+
+    num_buckets, bucket_size = choose_buckets(n, bucket_size)
+    n_tot = num_buckets * bucket_size
+    pad = n_tot - n
+
+    x = jnp.concatenate([points[:, 0], jnp.full((pad,), PAD_SENTINEL, jnp.float32)])
+    y = jnp.concatenate([points[:, 1], jnp.full((pad,), PAD_SENTINEL, jnp.float32)])
+    z = jnp.concatenate([points[:, 2], jnp.full((pad,), PAD_SENTINEL, jnp.float32)])
+    ids = jnp.concatenate([point_ids, jnp.full((pad,), -1, jnp.int32)])
+    pos = jnp.concatenate([jnp.arange(n, dtype=jnp.int32),
+                           jnp.full((pad,), -1, jnp.int32)])
+
+    num_levels = int(math.log2(num_buckets))
+    for level in range(num_levels):
+        num_seg = 1 << level
+        seg = n_tot // num_seg
+        seg_id = (jnp.arange(n_tot, dtype=jnp.int32) // seg)
+
+        # widest real-point extent per segment picks the split dimension
+        coords = jnp.stack([x, y, z], axis=1).reshape(num_seg, seg, 3)
+        valid = coords[:, :, 0:1] < PAD_SENTINEL / 2
+        lo = jnp.min(jnp.where(valid, coords, jnp.inf), axis=1)    # [seg, 3]
+        hi = jnp.max(jnp.where(valid, coords, -jnp.inf), axis=1)
+        ext = hi - lo
+        dim = jnp.argmax(jnp.where(jnp.isfinite(ext), ext, -jnp.inf),
+                         axis=1).astype(jnp.int32)                 # [num_seg]
+        dim_e = jnp.repeat(dim, seg, total_repeat_length=n_tot)
+        key = jnp.where(dim_e == 0, x, jnp.where(dim_e == 1, y, z))
+
+        _, _, x, y, z, ids, pos = lax.sort(
+            (seg_id, key, x, y, z, ids, pos), num_keys=2, is_stable=True)
+
+    pts = jnp.stack([x, y, z], axis=1).reshape(num_buckets, bucket_size, 3)
+    ids = ids.reshape(num_buckets, bucket_size)
+    pos = pos.reshape(num_buckets, bucket_size)
+
+    valid = pts[:, :, 0:1] < PAD_SENTINEL / 2
+    lower = jnp.min(jnp.where(valid, pts, jnp.inf), axis=1)
+    upper = jnp.max(jnp.where(valid, pts, -jnp.inf), axis=1)
+    return BucketedPoints(pts, ids, lower, upper, pos)
+
+
+def bucket_box_dist2(q_lower, q_upper, p_lower, p_upper) -> jnp.ndarray:
+    """Squared min box-to-box distance matrix f32[Bq, Bp].
+
+    Same per-component formula as the reference's ``computeDistance``
+    (prePartitionedDataVariant.cu:150-155), kept *squared* so pruning
+    compares against squared heap radii without a sqrt. Empty buckets
+    (inverted inf bounds) produce +inf — always prunable.
+    """
+    diff = jnp.maximum(0.0, jnp.maximum(q_lower[:, None, :] - p_upper[None, :, :],
+                                        p_lower[None, :, :] - q_upper[:, None, :]))
+    d2 = jnp.sum(diff * diff, axis=-1)
+    return jnp.where(jnp.isnan(d2), jnp.inf, d2)
+
+
+def scatter_back(values: jnp.ndarray, pos: jnp.ndarray, n_out: int,
+                 fill=0) -> jnp.ndarray:
+    """Scatter bucket-order ``values`` (any [B, S, ...]) back to input-row
+    order; bucket padding rows (pos == -1) are dropped, and input rows not
+    covered by ``pos`` hold ``fill``."""
+    flat_pos = pos.reshape(-1)
+    # -1 padding must map out of range, not wrap NumPy-style to the last row
+    flat_pos = jnp.where(flat_pos < 0, n_out, flat_pos)
+    flat_val = values.reshape((flat_pos.shape[0],) + values.shape[2:])
+    out = jnp.full((n_out,) + flat_val.shape[1:], fill, flat_val.dtype)
+    return out.at[flat_pos].set(flat_val, mode="drop")

@@ -1,0 +1,133 @@
+"""Bucketed nearest-first kNN engine — the TPU-native traversal.
+
+This engine is to a TPU what ``cukd::stackFree::knn`` (the reference's inner
+hot path, unorderedDataVariant.cu:86) is to a GPU. The GPU walks one implicit
+tree node per scalar thread, pruning subtrees farther than the query's
+current k-th candidate; a TPU has no scalar threads, so the same
+prune-ordered traversal is lifted to *tile* granularity:
+
+- points and queries are median-split into contiguous spatial buckets with
+  tight AABBs (ops/partition.py) — the tree's top levels;
+- every query bucket visits point buckets in ascending box-distance order
+  (the GPU traversal's "close child first" rule, made global);
+- a bucket is visited only while its squared box distance is strictly below
+  the query bucket's current worst k-th-candidate distance — the identical
+  prune predicate of the traversal (``cl.maxRadius2()``) and of the demand
+  engine's ``computeMyPeer`` (box-dist >= cutoff skips,
+  prePartitionedDataVariant.cu:157-174), so the search remains EXACT;
+- the loop ends when every query bucket's next-nearest unvisited bucket is
+  already beyond its radius — per-device early exit with no host round trip.
+
+Within a visited bucket pair the work is a dense [S, T] f32 distance tile
+folded into the persistent candidate rows — perfectly regular VPU work. For
+n uniform points this does O(visited_buckets * S * T) ~ O(k + surface)
+distance evaluations per query instead of brute force's O(n), while keeping
+every op a static-shape tile.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpi_cuda_largescaleknn_tpu.core.types import CandidateState
+from mpi_cuda_largescaleknn_tpu.ops.candidates import merge_candidates
+from mpi_cuda_largescaleknn_tpu.ops.partition import BucketedPoints, bucket_box_dist2
+
+
+def _default_chunk(num_buckets: int, s: int, t: int,
+                   budget_elems: int = 4_000_000) -> int:
+    """Power-of-two query-bucket chunk keeping the [C, S, T] distance tile
+    within ~``budget_elems`` f32 elements (bounds peak VMEM/HBM traffic)."""
+    c = max(1, budget_elems // max(s * t, 1))
+    c = 1 << int(math.log2(c))
+    return max(1, min(num_buckets, c))
+
+
+def _worst2(hd2: jnp.ndarray, qvalid: jnp.ndarray) -> jnp.ndarray:
+    """Per-query-bucket squared prune radius: max over the bucket's real
+    queries of their current k-th candidate dist2 (the tile-level analogue of
+    the reference's managed-memory ``atomicMax`` radius,
+    prePartitionedDataVariant.cu:91-94). -inf for all-padding buckets."""
+    kth = hd2[:, :, -1]
+    return jnp.max(jnp.where(qvalid, kth, -jnp.inf), axis=1)
+
+
+def knn_update_tiled(state: CandidateState, q: BucketedPoints,
+                     p: BucketedPoints, *, chunk_buckets: int | None = None
+                     ) -> CandidateState:
+    """Fold every real point of ``p`` into the candidate state (one
+    reference ``runQuery`` launch, at bucket granularity).
+
+    ``state`` rows are in ``q``'s bucket order: row ``b * S + i`` is query
+    ``q.pts[b, i]``. Returns the updated state in the same order.
+    """
+    num_qb, s_q = q.ids.shape
+    num_pb, s_p = p.ids.shape
+    k = state.dist2.shape[-1]
+
+    chunk = chunk_buckets or _default_chunk(num_qb, s_q, s_p)
+    assert num_qb % chunk == 0, (num_qb, chunk)
+    n_chunks = num_qb // chunk
+
+    box_d2 = bucket_box_dist2(q.lower, q.upper, p.lower, p.upper)  # [Bq, Bp]
+    iota = jnp.broadcast_to(jnp.arange(num_pb, dtype=jnp.int32)[None, :],
+                            box_d2.shape)
+    sorted_d2, order = lax.sort((box_d2, iota), num_keys=1, dimension=1,
+                                is_stable=True)
+
+    qvalid = q.ids >= 0
+    hd2 = state.dist2.reshape(num_qb, s_q, k)
+    hidx = state.idx.reshape(num_qb, s_q, k)
+
+    q_chunked = q.pts.reshape(n_chunks, chunk, s_q, 3)
+
+    def cond(carry):
+        _hd2, _hidx, worst2, step = carry
+        next_d2 = lax.dynamic_index_in_dim(sorted_d2, jnp.minimum(
+            step, num_pb - 1), axis=1, keepdims=False)
+        return (step < num_pb) & jnp.any(next_d2 < worst2)
+
+    def body(carry):
+        hd2, hidx, worst2, step = carry
+        visit = lax.dynamic_index_in_dim(order, step, axis=1, keepdims=False)
+        visit_d2 = lax.dynamic_index_in_dim(sorted_d2, step, axis=1,
+                                            keepdims=False)
+        active = visit_d2 < worst2                                  # [Bq]
+        pts_v = p.pts[visit]                                        # [Bq,T,3]
+        ids_v = p.ids[visit]                                        # [Bq,T]
+
+        def chunk_fn(args):
+            qp, pp, pid, act, cd2, cidx = args
+            dx = qp[:, :, None, 0] - pp[:, None, :, 0]
+            dy = qp[:, :, None, 1] - pp[:, None, :, 1]
+            dz = qp[:, :, None, 2] - pp[:, None, :, 2]
+            d2 = (dx * dx + dy * dy) + dz * dz                      # [C,S,T]
+            d2 = jnp.where(act[:, None, None], d2, jnp.inf)
+            st = merge_candidates(
+                CandidateState(cd2.reshape(chunk * s_q, k),
+                               cidx.reshape(chunk * s_q, k)),
+                d2.reshape(chunk * s_q, s_p),
+                jnp.broadcast_to(pid[:, None, :, ...],
+                                 (chunk, s_q, s_p)).reshape(chunk * s_q, s_p))
+            return (st.dist2.reshape(chunk, s_q, k),
+                    st.idx.reshape(chunk, s_q, k))
+
+        hd2, hidx = lax.map(chunk_fn, (
+            q_chunked,
+            pts_v.reshape(n_chunks, chunk, s_p, 3),
+            ids_v.reshape(n_chunks, chunk, s_p),
+            active.reshape(n_chunks, chunk),
+            hd2.reshape(n_chunks, chunk, s_q, k),
+            hidx.reshape(n_chunks, chunk, s_q, k)))
+        hd2 = hd2.reshape(num_qb, s_q, k)
+        hidx = hidx.reshape(num_qb, s_q, k)
+        return hd2, hidx, _worst2(hd2, qvalid), step + 1
+
+    init = (hd2, hidx, _worst2(hd2, qvalid), jnp.int32(0))
+    hd2, hidx, _, _ = lax.while_loop(cond, body, init)
+    return CandidateState(hd2.reshape(num_qb * s_q, k),
+                          hidx.reshape(num_qb * s_q, k))

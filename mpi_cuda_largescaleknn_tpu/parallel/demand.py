@@ -54,14 +54,20 @@ from mpi_cuda_largescaleknn_tpu.ops.candidates import (
     extract_final_result,
     init_candidates,
 )
+from mpi_cuda_largescaleknn_tpu.ops.partition import (
+    partition_points,
+    scatter_back,
+)
+from mpi_cuda_largescaleknn_tpu.ops.tiled import knn_update_tiled
 from mpi_cuda_largescaleknn_tpu.parallel.mesh import AXIS, pvary
 from mpi_cuda_largescaleknn_tpu.parallel.ring import _engine_fn
 
 
 def demand_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
                mesh, *, max_radius: float = jnp.inf,
-               engine: str = "bruteforce", query_tile: int = 2048,
-               point_tile: int = 2048, return_stats: bool = False):
+               engine: str = "auto", query_tile: int = 2048,
+               point_tile: int = 2048, bucket_size: int = 512,
+               return_stats: bool = False):
     """Bounds-pruned kNN over pre-partitioned shards on a 1-D mesh.
 
     Same data contract as ring_knn (shard-major padded rows); additionally
@@ -70,18 +76,35 @@ def demand_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
     reference only exposes as per-round stdout prints (:306).
     """
     num_shards = mesh.shape[AXIS]
-    update = _engine_fn(engine, query_tile, point_tile)
+    use_tiled = engine in ("tiled", "auto")
+    update = None if use_tiled else _engine_fn(engine, query_tile, point_tile)
     use_tree = engine == "tree"
     fwd = [(i, (i + 1) % num_shards) for i in range(num_shards)]
 
     def body(pts_local, ids_local):
         me = jax.lax.axis_index(AXIS)
-        queries = pts_local
+        npad = pts_local.shape[0]
         valid = pts_local[:, 0] < PAD_SENTINEL / 2
-        if use_tree:
+        if use_tiled:
+            # bucketed structures: queries and the rotating shard both carry
+            # per-bucket bounds; the tile-level prune inside knn_update_tiled
+            # subsumes most of the shard-level skip, which remains as a
+            # cheap outer gate
+            q = partition_points(pts_local, ids_local,
+                                 bucket_size=bucket_size)
+            queries = None
+            shard_state = (q.pts, q.ids, q.lower, q.upper)
+            heap_rows = q.num_buckets * q.bucket_size
+            heap_valid = (q.ids >= 0).reshape(-1)
+        elif use_tree:
+            queries = pts_local
             shard, shard_ids = build_tree(pts_local, ids_local)
+            shard_state = (shard, shard_ids)
+            heap_rows, heap_valid = npad, valid
         else:
-            shard, shard_ids = pts_local, ids_local
+            queries = pts_local
+            shard_state = (pts_local, ids_local)
+            heap_rows, heap_valid = npad, valid
 
         # bounds of every shard's real points, replicated to all devices
         # (the reference's Allgather of 6-float boxes, :290-291)
@@ -94,18 +117,19 @@ def demand_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
         # shard s arrives at this device in round (me - s) mod R
         arrival_round = jnp.mod(me - jnp.arange(num_shards), num_shards)
 
-        heap = pvary(init_candidates(queries.shape[0], k, max_radius))
+        heap = pvary(init_candidates(heap_rows, k, max_radius))
 
         def cond(carry):
-            _shard, _ids, _hd2, _hidx, rnd, keep_going, _nrun = carry
+            _shard, _hd2, _hidx, rnd, keep_going, _nrun = carry
             return (rnd < num_shards) & keep_going
 
         def round_body(carry):
-            shard, shard_ids, hd2, hidx, rnd, _kg, nrun = carry
-            nxt = jax.lax.ppermute(shard, AXIS, fwd)
-            nxt_ids = jax.lax.ppermute(shard_ids, AXIS, fwd)
+            shard_state, hd2, hidx, rnd, _kg, nrun = carry
+            nxt = jax.tree.map(lambda a: jax.lax.ppermute(a, AXIS, fwd),
+                               shard_state)
 
-            cur_radius = current_worst_radius(CandidateState(hd2, hidx), valid)
+            cur_radius = current_worst_radius(CandidateState(hd2, hidx),
+                                              heap_valid)
             src = jnp.mod(me - rnd, num_shards)
             # visit iff the resident shard's box is strictly closer than the
             # current worst k-th distance (computeMyPeer's prune, :168);
@@ -114,26 +138,41 @@ def demand_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
                 box_dist, src, keepdims=False) < cur_radius
 
             def run(_):
-                st = update(CandidateState(hd2, hidx), queries, shard, shard_ids)
+                if use_tiled:
+                    resident = q._replace(
+                        pts=shard_state[0], ids=shard_state[1],
+                        lower=shard_state[2], upper=shard_state[3])
+                    st = knn_update_tiled(CandidateState(hd2, hidx), q,
+                                          resident)
+                else:
+                    st = update(CandidateState(hd2, hidx), queries,
+                                *shard_state)
                 return st.dist2, st.idx
 
             hd2, hidx = jax.lax.cond(do_visit, run, lambda _: (hd2, hidx), None)
             nrun = nrun + do_visit.astype(jnp.int32)
 
             # global early exit: does ANY device still need ANY unseen shard?
-            new_radius = current_worst_radius(CandidateState(hd2, hidx), valid)
+            new_radius = current_worst_radius(CandidateState(hd2, hidx),
+                                              heap_valid)
             i_need_more = jnp.any((arrival_round > rnd) & (box_dist < new_radius))
             keep_going = jax.lax.pmax(i_need_more.astype(jnp.int32), AXIS) > 0
-            return nxt, nxt_ids, hd2, hidx, rnd + 1, keep_going, nrun
+            return nxt, hd2, hidx, rnd + 1, keep_going, nrun
 
         # rnd and keep_going are uniform across devices (keep_going is a pmax
         # reduction, hence replicated); nrun is per-device
-        init = (shard, shard_ids, heap.dist2, heap.idx,
+        init = (shard_state, heap.dist2, heap.idx,
                 jnp.int32(0), jnp.bool_(True), pvary(jnp.int32(0)))
-        _, _, hd2, hidx, rounds, _, nrun = jax.lax.while_loop(cond, round_body, init)
+        _, hd2, hidx, rounds, _, nrun = jax.lax.while_loop(cond, round_body, init)
         heap = CandidateState(hd2, hidx)
-        return (extract_final_result(heap), hd2, hidx,
-                pvary(rounds)[None], nrun[None])
+        dists = extract_final_result(heap)
+        if use_tiled:
+            bs = (q.num_buckets, q.bucket_size)
+            dists = scatter_back(dists.reshape(bs), q.pos, npad, fill=jnp.inf)
+            hd2 = scatter_back(hd2.reshape(bs + (k,)), q.pos, npad,
+                               fill=jnp.inf)
+            hidx = scatter_back(hidx.reshape(bs + (k,)), q.pos, npad, fill=-1)
+        return dists, hd2, hidx, pvary(rounds)[None], nrun[None]
 
     spec = P(AXIS)
     mapped = jax.jit(jax.shard_map(

@@ -75,5 +75,13 @@ def pvary(x):
     shard_map to keep a consistent varying type; freshly-initialized
     constants (e.g. empty candidate heaps) start replicated and must be cast
     before entering a loop whose body mixes them with sharded data.
+    Idempotent: leaves already varying along AXIS pass through unchanged.
     """
-    return jax.tree.map(lambda a: jax.lax.pcast(a, (AXIS,), to="varying"), x)
+
+    def cast(a):
+        vma = getattr(jax.typeof(a), "vma", frozenset())
+        if AXIS in vma:
+            return a
+        return jax.lax.pcast(a, (AXIS,), to="varying")
+
+    return jax.tree.map(cast, x)

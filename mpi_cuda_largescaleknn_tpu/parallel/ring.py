@@ -43,12 +43,19 @@ from mpi_cuda_largescaleknn_tpu.ops.candidates import (
     extract_final_result,
     init_candidates,
 )
+from mpi_cuda_largescaleknn_tpu.ops.partition import (
+    partition_points,
+    scatter_back,
+)
+from mpi_cuda_largescaleknn_tpu.ops.tiled import knn_update_tiled
 from mpi_cuda_largescaleknn_tpu.ops.traverse import knn_update_tree
 from mpi_cuda_largescaleknn_tpu.parallel.mesh import AXIS, pvary
 
 
 def _engine_fn(engine: str, query_tile: int, point_tile: int):
-    if engine in ("bruteforce", "auto"):
+    # flat-engine dispatch only; "auto"/"tiled" take the bucketed data path
+    # (body_tiled here, the q/shard_state branch in demand_knn) before this
+    if engine == "bruteforce":
         return partial(knn_update_bruteforce, query_tile=query_tile,
                        point_tile=point_tile)
     if engine == "tree":
@@ -67,9 +74,9 @@ def _engine_fn(engine: str, query_tile: int, point_tile: int):
 
 
 def ring_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
-             mesh, *, max_radius: float = jnp.inf, engine: str = "bruteforce",
+             mesh, *, max_radius: float = jnp.inf, engine: str = "auto",
              query_tile: int = 2048, point_tile: int = 2048,
-             return_candidates: bool = False):
+             bucket_size: int = 512, return_candidates: bool = False):
     """Run the full R-round ring on a 1-D mesh.
 
     Args:
@@ -87,11 +94,40 @@ def ring_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
       padding rows), plus the CandidateState if ``return_candidates``.
     """
     num_shards = mesh.shape[AXIS]
-    update = _engine_fn(engine, query_tile, point_tile)
+    use_tiled = engine in ("tiled", "auto")
+    update = None if use_tiled else _engine_fn(engine, query_tile, point_tile)
     use_tree = engine == "tree"
     fwd = [(i, (i + 1) % num_shards) for i in range(num_shards)]
 
-    def body(pts_local, ids_local):
+    def body_tiled(pts_local, ids_local):
+        npad = pts_local.shape[0]
+        q = partition_points(pts_local, ids_local, bucket_size=bucket_size)
+        heap = pvary(init_candidates(q.num_buckets * q.bucket_size, k,
+                                     max_radius))
+        # the rotating "tree" = the bucketed shard + its bucket bounds; pos
+        # only matters query-side, so it does not ride the ring
+        shard = (q.pts, q.ids, q.lower, q.upper)
+
+        def round_body(_i, carry):
+            shard, hd2, hidx = carry
+            nxt = jax.tree.map(lambda a: jax.lax.ppermute(a, AXIS, fwd), shard)
+            resident = q._replace(pts=shard[0], ids=shard[1], lower=shard[2],
+                                  upper=shard[3])
+            st = knn_update_tiled(CandidateState(hd2, hidx), q, resident)
+            return nxt, st.dist2, st.idx
+
+        _, hd2, hidx = jax.lax.fori_loop(
+            0, num_shards, round_body, (shard, heap.dist2, heap.idx))
+        heap = CandidateState(hd2, hidx)
+        bs = (q.num_buckets, q.bucket_size)
+        dists = scatter_back(extract_final_result(heap).reshape(bs),
+                             q.pos, npad, fill=jnp.inf)
+        hd2 = scatter_back(heap.dist2.reshape(bs + (k,)), q.pos, npad,
+                           fill=jnp.inf)
+        hidx = scatter_back(heap.idx.reshape(bs + (k,)), q.pos, npad, fill=-1)
+        return dists, hd2, hidx
+
+    def body_flat(pts_local, ids_local):
         queries = pts_local
         if use_tree:
             shard, shard_ids = build_tree(pts_local, ids_local)
@@ -113,6 +149,8 @@ def ring_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
             (shard, shard_ids, heap.dist2, heap.idx))
         heap = CandidateState(hd2, hidx)
         return extract_final_result(heap), heap.dist2, heap.idx
+
+    body = body_tiled if use_tiled else body_flat
 
     shard_spec = P(AXIS)
     mapped = jax.jit(jax.shard_map(

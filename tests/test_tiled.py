@@ -1,0 +1,173 @@
+"""Bucketed nearest-first engine (ops/partition.py + ops/tiled.py) vs oracle."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_cuda_largescaleknn_tpu.core.types import PAD_SENTINEL, CandidateState
+from mpi_cuda_largescaleknn_tpu.ops.candidates import (
+    extract_final_result,
+    init_candidates,
+)
+from mpi_cuda_largescaleknn_tpu.ops.partition import (
+    partition_points,
+    scatter_back,
+)
+from mpi_cuda_largescaleknn_tpu.ops.tiled import knn_update_tiled
+from tests.oracle import assert_dist_equal, kth_nn_dist, random_points
+
+
+def tiled_self_knn(pts, k, max_radius=np.inf, bucket_size=32):
+    """Single-shard tiled kNN of a point set against itself."""
+    q = partition_points(jnp.asarray(pts), bucket_size=bucket_size)
+    state = init_candidates(q.num_buckets * q.bucket_size, k, max_radius)
+    state = knn_update_tiled(state, q, q)
+    d = extract_final_result(state).reshape(q.num_buckets, q.bucket_size)
+    return np.asarray(scatter_back(d, q.pos, len(pts), fill=jnp.inf))
+
+
+class TestPartition:
+    def test_partition_is_permutation(self):
+        pts = random_points(501, seed=3)
+        q = partition_points(jnp.asarray(pts), bucket_size=32)
+        pos = np.asarray(q.pos).ravel()
+        real = pos[pos >= 0]
+        assert sorted(real) == list(range(501))
+        # each real bucketed row equals the input row it claims to be
+        flat_pts = np.asarray(q.pts).reshape(-1, 3)
+        np.testing.assert_array_equal(flat_pts[pos >= 0], pts[real])
+        # ids carry through identically
+        ids = np.asarray(q.ids).ravel()
+        np.testing.assert_array_equal(ids[pos >= 0], real)
+
+    def test_bounds_cover_their_points(self):
+        pts = random_points(300, seed=4)
+        q = partition_points(jnp.asarray(pts), bucket_size=16)
+        p = np.asarray(q.pts)
+        lo, hi = np.asarray(q.lower), np.asarray(q.upper)
+        for b in range(q.num_buckets):
+            real = p[b][p[b, :, 0] < PAD_SENTINEL / 2]
+            if len(real) == 0:
+                assert np.all(np.isinf(lo[b])) and np.all(np.isinf(hi[b]))
+            else:
+                assert np.all(real >= lo[b] - 1e-6)
+                assert np.all(real <= hi[b] + 1e-6)
+
+    def test_scatter_back_roundtrip(self):
+        pts = random_points(77, seed=5)
+        q = partition_points(jnp.asarray(pts), bucket_size=8)
+        vals = q.pts[:, :, 0]
+        back = np.asarray(scatter_back(vals, q.pos, 77, fill=jnp.inf))
+        np.testing.assert_array_equal(back, pts[:, 0])
+
+
+class TestTiledEngine:
+    @pytest.mark.parametrize("n,k", [(100, 1), (257, 8), (1000, 13), (64, 64)])
+    def test_matches_oracle(self, n, k):
+        pts = random_points(n, seed=n)
+        want = kth_nn_dist(pts, pts, k)
+        assert_dist_equal(tiled_self_knn(pts, k), want)
+
+    def test_k_exceeds_n_gives_inf(self):
+        pts = random_points(10, seed=1)
+        got = tiled_self_knn(pts, 32)
+        assert np.all(np.isinf(got))
+
+    def test_max_radius_cutoff(self):
+        pts = random_points(400, seed=9, scale=4.0)
+        r = 0.35
+        want = kth_nn_dist(pts, pts, 6, max_radius=r)
+        assert_dist_equal(tiled_self_knn(pts, 6, max_radius=r), want)
+
+    def test_clustered_data(self):
+        # two far-apart clusters: pruning must never cut a true neighbor
+        rng = np.random.default_rng(11)
+        a = (rng.random((150, 3)) * 0.1).astype(np.float32)
+        b = (rng.random((150, 3)) * 0.1 + 50.0).astype(np.float32)
+        pts = np.concatenate([a, b]).astype(np.float32)
+        want = kth_nn_dist(pts, pts, 5)
+        assert_dist_equal(tiled_self_knn(pts, 5, bucket_size=16), want)
+
+    def test_duplicate_points_ties(self):
+        pts = np.repeat(random_points(40, seed=13), 4, axis=0)
+        want = kth_nn_dist(pts, pts, 7)
+        assert_dist_equal(tiled_self_knn(pts, 7, bucket_size=16), want)
+
+    def test_adoption_across_updates(self):
+        # folding two disjoint shards sequentially == one-shot over the union
+        pts = random_points(300, seed=17)
+        a, b = pts[:151], pts[151:]
+        k = 9
+        q = partition_points(jnp.asarray(pts), bucket_size=16)
+        pa = partition_points(jnp.asarray(a), jnp.arange(151, dtype=jnp.int32),
+                              bucket_size=16)
+        pb = partition_points(jnp.asarray(b),
+                              jnp.arange(151, 300, dtype=jnp.int32),
+                              bucket_size=16)
+        state = init_candidates(q.num_buckets * q.bucket_size, k)
+        state = knn_update_tiled(state, q, pa)
+        state = knn_update_tiled(state, q, pb)
+        d = extract_final_result(state).reshape(q.num_buckets, q.bucket_size)
+        got = np.asarray(scatter_back(d, q.pos, len(pts), fill=jnp.inf))
+        assert_dist_equal(got, kth_nn_dist(pts, pts, k))
+
+    def test_neighbor_ids_are_true_neighbors(self):
+        pts = random_points(120, seed=19)
+        k = 4
+        q = partition_points(jnp.asarray(pts), bucket_size=16)
+        state = init_candidates(q.num_buckets * q.bucket_size, k)
+        state = knn_update_tiled(state, q, q)
+        bs = (q.num_buckets, q.bucket_size)
+        idx = np.asarray(scatter_back(state.idx.reshape(bs + (k,)), q.pos,
+                                      len(pts), fill=-1))
+        d2 = np.asarray(scatter_back(state.dist2.reshape(bs + (k,)), q.pos,
+                                     len(pts), fill=jnp.inf))
+        from tests.oracle import pairwise_dist2_np
+        full = pairwise_dist2_np(pts, pts)
+        for i in range(len(pts)):
+            np.testing.assert_allclose(
+                np.sort(d2[i]), np.sort(full[i])[:k], rtol=5e-7)
+            assert idx[i, 0] == i or d2[i, 0] == 0.0  # self is the 1-NN
+
+
+class TestTiledInRing:
+    def test_ring_tiled_matches_oracle_8dev(self):
+        import jax
+
+        from mpi_cuda_largescaleknn_tpu.core.config import KnnConfig
+        from mpi_cuda_largescaleknn_tpu.models.unordered import UnorderedKNN
+        from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+
+        pts = random_points(803, seed=23)
+        k = 6
+        cfg = KnnConfig(k=k, engine="tiled", bucket_size=16)
+        got = UnorderedKNN(cfg, mesh=get_mesh(len(jax.devices()))).run(pts)
+        assert_dist_equal(got, kth_nn_dist(pts, pts, k))
+
+    def test_demand_tiled_matches_oracle(self):
+        from mpi_cuda_largescaleknn_tpu.core.config import KnnConfig
+        from mpi_cuda_largescaleknn_tpu.models.prepartitioned import (
+            PrePartitionedKNN,
+        )
+        from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+
+        # 8 spatially-coherent partitions (sorted by x then slabbed)
+        pts = random_points(640, seed=31)
+        pts = pts[np.argsort(pts[:, 0], kind="stable")]
+        parts = [pts[i * 80:(i + 1) * 80] for i in range(8)]
+        cfg = KnnConfig(k=5, engine="tiled", bucket_size=16)
+        model = PrePartitionedKNN(cfg, mesh=get_mesh(8))
+        got = np.concatenate(model.run(parts))
+        assert_dist_equal(got, kth_nn_dist(pts, pts, 5))
+        assert model.last_stats["rounds"] <= 8
+
+    def test_ring_tiled_matches_single_device(self):
+        from mpi_cuda_largescaleknn_tpu.core.config import KnnConfig
+        from mpi_cuda_largescaleknn_tpu.models.unordered import UnorderedKNN
+        from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+
+        pts = random_points(500, seed=29)
+        cfg = KnnConfig(k=5, engine="tiled", bucket_size=16)
+        one = UnorderedKNN(cfg, mesh=get_mesh(1)).run(pts)
+        eight = UnorderedKNN(cfg, mesh=get_mesh(8)).run(pts)
+        np.testing.assert_array_equal(one, eight)
