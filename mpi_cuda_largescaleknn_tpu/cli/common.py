@@ -18,8 +18,9 @@ from mpi_cuda_largescaleknn_tpu.core.config import KnnConfig
 TPU_FLAGS = """
 TPU-side options (no reference analogue):
   --shards N        size of the 1-D device mesh (default: all devices)
-  --engine E        tiled | bruteforce | tree | pallas | auto (default
-                    auto = tiled, the bucketed nearest-first engine)
+  --engine E        tiled | pallas_tiled | bruteforce | tree | pallas | auto
+                    (default auto = tiled, the bucketed nearest-first engine;
+                    pallas_tiled is its fused-kernel form for real TPUs)
   --query-tile N    queries per inner tile (flat engines; default 2048)
   --point-tile N    tree points per inner tile (flat engines; default 2048)
   --bucket-size N   points per spatial bucket (tiled engine; default 512)

@@ -24,8 +24,8 @@ class KnnConfig:
                                      # the runtime owns device binding)
 
     # --- TPU-side knobs ----------------------------------------------------
-    engine: str = "auto"             # "auto" (= tiled) | "tiled" | "bruteforce"
-                                     # | "tree" | "pallas"
+    engine: str = "auto"             # "auto" (= tiled) | "tiled" | "pallas_tiled"
+                                     # | "bruteforce" | "tree" | "pallas"
     query_tile: int = 2048           # queries processed per inner tile
     point_tile: int = 2048           # tree points per inner tile
     bucket_size: int = 512           # tiled engine: points per spatial bucket
@@ -36,5 +36,6 @@ class KnnConfig:
     def validate(self) -> None:
         if self.k < 1:
             raise ValueError("no k specified, or invalid k value")
-        if self.engine not in ("auto", "tiled", "bruteforce", "tree", "pallas"):
+        if self.engine not in ("auto", "tiled", "pallas_tiled", "bruteforce",
+                               "tree", "pallas"):
             raise ValueError(f"unknown engine '{self.engine}'")

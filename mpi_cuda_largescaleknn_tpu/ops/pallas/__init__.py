@@ -1,0 +1,24 @@
+"""Pallas TPU kernels for the hot query path.
+
+Each kernel has a pure-XLA twin in ops/ with identical semantics; the Pallas
+versions fuse distance evaluation with the top-k merge so the candidate state
+stays resident in VMEM across point tiles instead of round-tripping to HBM
+through an ``lax.sort`` per tile.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def is_tpu_backend() -> bool:
+    """True when the default backend is real TPU hardware — including the
+    ``axon`` PJRT tunnel, whose platform name is not ``tpu`` but whose
+    devices are TPU chips (Pallas kernels compile via Mosaic on it)."""
+    try:
+        if jax.default_backend() == "tpu":
+            return True
+        dev = jax.devices()[0]
+        return "TPU" in getattr(dev, "device_kind", "")
+    except Exception:
+        return False

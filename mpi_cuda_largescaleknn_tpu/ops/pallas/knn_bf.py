@@ -1,0 +1,192 @@
+"""Fused brute-force kNN Pallas kernel (flat engine ``pallas``).
+
+Same contract as ``ops.brute_force.knn_update_bruteforce`` (one reference
+``runQuery`` launch, unorderedDataVariant.cu:199-203): fold every point of the
+resident shard into each query's persistent top-k candidate row. The XLA twin
+materializes each [S, T] distance tile and merges it through a width-2k
+``lax.sort``; here the distance tile, the threshold test, and the merge are one
+kernel, and the candidate rows stay in VMEM across *all* point tiles of a
+query tile (grid revisiting), touching HBM once per query tile.
+
+Merge algorithm (exact, heap-free): per while-loop iteration every query row
+extracts the minimum of its remaining distance row; rows whose minimum beats
+their current k-th candidate insert it into their sorted candidate row
+(strict-``<`` entry, ties keep existing entries first — FlexHeapCandidateList
+semantics, ops/candidates.py) and mask that lane to +inf. The loop ends when
+no row can improve — for a random point stream the expected iteration count
+per tile decays as ~k/tiles_seen, so the merge costs a few [S, T] passes
+total instead of a sort per tile.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from mpi_cuda_largescaleknn_tpu.core.types import PAD_SENTINEL, CandidateState
+from mpi_cuda_largescaleknn_tpu.utils.math import cdiv
+
+_NEG_BIG = -(2**31) + 1  # int32 "minus infinity" for one-hot id extraction
+
+
+def fold_tile_into_candidates(d2, ids_row, cand_d2, cand_idx):
+    """Fold a distance tile ``f32[S, T]`` into sorted candidate rows.
+
+    ``ids_row``: i32[1, T] point ids for the tile's lanes. Returns updated
+    (cand_d2, cand_idx), both [S, k]. Pure jnp — usable inside any kernel (or
+    interpreted for tests).
+    """
+    s, t = d2.shape
+    k = cand_d2.shape[1]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (s, t), 1)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (s, k), 1)
+    ids_b = jnp.broadcast_to(ids_row, (s, t))
+
+    def cond(carry):
+        return carry[0]
+
+    def body(carry):
+        _, d2, cd2, cidx = carry
+        m = jnp.min(d2, axis=1)                       # [S]
+        improved = m < cd2[:, -1]
+        # first lane holding the row minimum
+        is_min = d2 == m[:, None]
+        ml = jnp.min(jnp.where(is_min, lane, t), axis=1)
+        sel = is_min & (lane == ml[:, None])
+        mid = jnp.max(jnp.where(sel, ids_b, _NEG_BIG), axis=1)
+        # consume the extracted lane
+        d2 = jnp.where(sel & improved[:, None], jnp.inf, d2)
+
+        # sorted insert: after any equal entries (stable, existing first);
+        # right-shift by one (the shifted col 0 is never selected: col > pos
+        # is impossible at col 0)
+        pos = jnp.sum((cd2 <= m[:, None]).astype(jnp.int32), axis=1)
+        roll_d2 = jnp.concatenate([cd2[:, :1], cd2[:, :-1]], axis=1)
+        roll_idx = jnp.concatenate([cidx[:, :1], cidx[:, :-1]], axis=1)
+        ins_d2 = jnp.where(cols < pos[:, None], cd2,
+                           jnp.where(cols == pos[:, None], m[:, None], roll_d2))
+        ins_idx = jnp.where(cols < pos[:, None], cidx,
+                            jnp.where(cols == pos[:, None], mid[:, None],
+                                      roll_idx))
+        cd2 = jnp.where(improved[:, None], ins_d2, cd2)
+        cidx = jnp.where(improved[:, None], ins_idx, cidx)
+        go = jnp.any(jnp.min(d2, axis=1) < cd2[:, -1])
+        return go, d2, cd2, cidx
+
+    go0 = jnp.any(jnp.min(d2, axis=1) < cand_d2[:, -1])
+    _, _, cand_d2, cand_idx = jax.lax.while_loop(
+        cond, body, (go0, d2, cand_d2, cand_idx))
+    return cand_d2, cand_idx
+
+
+def _kernel(q_ref, pt_ref, pid_ref, in_d2_ref, in_idx_ref,
+            out_d2_ref, out_idx_ref):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        out_d2_ref[:] = in_d2_ref[:]
+        out_idx_ref[:] = in_idx_ref[:]
+
+    q = q_ref[:]                                   # [S, 3]
+    dx = q[:, 0:1] - pt_ref[0:1, :]                # [S, T]
+    dy = q[:, 1:2] - pt_ref[1:2, :]
+    dz = q[:, 2:3] - pt_ref[2:3, :]
+    d2 = (dx * dx + dy * dy) + dz * dz
+
+    cd2, cidx = fold_tile_into_candidates(d2, pid_ref[:], out_d2_ref[:],
+                                          out_idx_ref[:])
+    out_d2_ref[:] = cd2
+    out_idx_ref[:] = cidx
+
+
+@functools.partial(jax.jit, static_argnames=("query_tile", "point_tile",
+                                             "interpret"))
+def _run(q_pad, p_t, ids_2d, in_d2, in_idx, *, query_tile, point_tile,
+         interpret):
+    nq, k = in_d2.shape
+    npts = p_t.shape[1]
+    grid = (nq // query_tile, npts // point_tile)
+    out_d2, out_idx = pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((query_tile, 3), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, point_tile), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, point_tile), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((query_tile, k), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((query_tile, k), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((query_tile, k), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((query_tile, k), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            # under shard_map the outputs vary over the same mesh axes as the
+            # candidate state; outside, vma is empty and this is a no-op
+            jax.ShapeDtypeStruct((nq, k), jnp.float32,
+                                 vma=getattr(jax.typeof(in_d2), "vma",
+                                             frozenset())),
+            jax.ShapeDtypeStruct((nq, k), jnp.int32,
+                                 vma=getattr(jax.typeof(in_idx), "vma",
+                                             frozenset())),
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(q_pad, p_t, ids_2d, in_d2, in_idx)
+    return out_d2, out_idx
+
+
+def _pad_rows(arr, target, fill):
+    n = arr.shape[0]
+    if n == target:
+        return arr
+    pad_shape = (target - n,) + arr.shape[1:]
+    return jnp.concatenate([arr, jnp.full(pad_shape, fill, arr.dtype)], axis=0)
+
+
+def knn_update_pallas(state: CandidateState, queries: jnp.ndarray,
+                      points: jnp.ndarray, point_ids: jnp.ndarray | None = None,
+                      *, query_tile: int = 256, point_tile: int = 2048,
+                      interpret: bool | None = None) -> CandidateState:
+    """Drop-in Pallas twin of ``knn_update_bruteforce``.
+
+    ``interpret=None`` auto-selects interpreter mode off-TPU so the same tests
+    run on the CPU fixture.
+    """
+    if interpret is None:
+        from mpi_cuda_largescaleknn_tpu.ops.pallas import is_tpu_backend
+        interpret = not is_tpu_backend()
+    num_q, k = state.dist2.shape
+    num_p = points.shape[0]
+    if num_p == 0:
+        return state
+    if point_ids is None:
+        point_ids = jnp.arange(num_p, dtype=jnp.int32)
+
+    qt = min(query_tile, max(8, num_q))
+    pt = min(point_tile, max(128, num_p))
+    nq_pad = cdiv(num_q, qt) * qt
+    np_pad = cdiv(num_p, pt) * pt
+
+    q_pad = _pad_rows(jnp.asarray(queries, jnp.float32), nq_pad, PAD_SENTINEL)
+    p_pad = _pad_rows(jnp.asarray(points, jnp.float32), np_pad, PAD_SENTINEL)
+    ids_2d = _pad_rows(jnp.asarray(point_ids, jnp.int32), np_pad, -1)[None, :]
+    in_d2 = _pad_rows(state.dist2, nq_pad, jnp.inf)
+    in_idx = _pad_rows(state.idx, nq_pad, -1)
+
+    out_d2, out_idx = _run(q_pad, p_pad.T, ids_2d, in_d2, in_idx,
+                           query_tile=qt, point_tile=pt, interpret=interpret)
+    return CandidateState(out_d2[:num_q], out_idx[:num_q])

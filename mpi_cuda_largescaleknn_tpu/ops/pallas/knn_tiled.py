@@ -1,0 +1,195 @@
+"""Bucketed nearest-first traversal as ONE Pallas kernel (engine ``pallas``
+inside the tiled data path).
+
+This is the TPU-native ``cukd::stackFree::knn`` (the reference's innermost hot
+loop, unorderedDataVariant.cu:86): where the GPU walks one implicit-tree node
+per scalar thread, pruning subtrees beyond the query's current k-th candidate,
+this kernel walks one *point bucket* per step for a whole query bucket,
+pruning buckets beyond the bucket's worst k-th candidate — the identical
+nearest-first, radius-pruned search at tile granularity (see ops/tiled.py for
+the algorithmic argument; this kernel is its fused form).
+
+vs. the XLA twin (``ops.tiled.knn_update_tiled``), which lock-steps ALL query
+buckets through one global visit counter and materializes every [S, T]
+distance tile + a width-2k sort per visit, here:
+
+- each query bucket advances its own ``lax.while_loop`` and exits as soon as
+  *its* next-nearest unvisited bucket is beyond *its* radius (the GPU's
+  per-thread early exit, recovered);
+- the candidate rows live in VMEM for the bucket's whole traversal — HBM sees
+  them once;
+- point buckets are fetched from HBM with double-buffered async DMA keyed by
+  the precomputed visit order, so the next bucket streams in while the
+  current one is scored (the comm/compute overlap the reference forgoes,
+  unorderedDataVariant.cu:204 — here at the memory level);
+- the visit order and box distances are scalar-prefetched to SMEM, steering
+  the DMAs without touching the vector core.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax import lax
+
+from mpi_cuda_largescaleknn_tpu.core.types import CandidateState
+from mpi_cuda_largescaleknn_tpu.ops.pallas.knn_bf import (
+    fold_tile_into_candidates,
+)
+from mpi_cuda_largescaleknn_tpu.ops.partition import (
+    BucketedPoints,
+    nearest_first_order,
+)
+
+
+def _kernel(order_ref, boxd2_ref,            # SMEM: [1, Bp] i32 / f32
+            q_ref, qid_ref,                  # VMEM: [1, S, 3] / [1, S]
+            in_d2_ref, in_idx_ref,           # VMEM: [S, k]
+            p_hbm, pid_hbm,                  # ANY (HBM): [Bp, 3, T] / [Bp, 1, T]
+            out_d2_ref, out_idx_ref,         # VMEM: [S, k]
+            p_buf, id_buf, sems):            # scratch: [2,3,T], [2,1,T], (2,2)
+    num_pb = p_hbm.shape[0]
+    q = q_ref[0]                             # [S, 3]
+    qvalid = qid_ref[0, :] >= 0              # [S]
+
+    def dma_pts(slot, visit):
+        return pltpu.make_async_copy(p_hbm.at[visit], p_buf.at[slot],
+                                     sems.at[slot, 0])
+
+    def dma_ids(slot, visit):
+        return pltpu.make_async_copy(pid_hbm.at[visit], id_buf.at[slot],
+                                     sems.at[slot, 1])
+
+    def start(slot, s):
+        visit = order_ref[0, s]
+        dma_pts(slot, visit).start()
+        dma_ids(slot, visit).start()
+
+    def wait(slot, s):
+        visit = order_ref[0, s]
+        dma_pts(slot, visit).wait()
+        dma_ids(slot, visit).wait()
+
+    def worst2(cd2):
+        return jnp.max(jnp.where(qvalid, cd2[:, -1], -jnp.inf))
+
+    start(0, 0)
+
+    def cond(carry):
+        s, cd2, _cidx = carry
+        # & does not short-circuit in traced code: clamp the index so the
+        # final evaluation at s == num_pb stays in bounds (cf. ops/tiled.py)
+        s_safe = jnp.minimum(s, num_pb - 1)
+        return (s < num_pb) & (boxd2_ref[0, s_safe] < worst2(cd2))
+
+    def body(carry):
+        s, cd2, cidx = carry
+        slot = lax.rem(s, 2)
+
+        @pl.when(s + 1 < num_pb)
+        def _():
+            start(lax.rem(s + 1, 2), s + 1)
+
+        wait(slot, s)
+        p = p_buf[slot]                       # [3, T]
+        ids = id_buf[slot]                    # [1, T]
+        dx = q[:, 0:1] - p[0:1, :]
+        dy = q[:, 1:2] - p[1:2, :]
+        dz = q[:, 2:3] - p[2:3, :]
+        d2 = (dx * dx + dy * dy) + dz * dz    # [S, T]
+        cd2, cidx = fold_tile_into_candidates(d2, ids, cd2, cidx)
+        return s + 1, cd2, cidx
+
+    s_exit, cd2, cidx = lax.while_loop(
+        cond, body, (jnp.int32(0), in_d2_ref[:], in_idx_ref[:]))
+
+    # a prefetch for s_exit is in flight whenever the loop stopped short of
+    # the end (started initially for s=0 or by the body for s+1); drain it so
+    # no DMA outlives the kernel
+    @pl.when(s_exit < num_pb)
+    def _():
+        wait(lax.rem(s_exit, 2), s_exit)
+
+    out_d2_ref[:] = cd2
+    out_idx_ref[:] = cidx
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _run(order, boxd2, q_pts, q_ids, in_d2, in_idx, p_t, pid_t, *, interpret):
+    num_qb, s_q = q_ids.shape
+    num_pb, _, t_p = p_t.shape
+    k = in_d2.shape[-1]
+    grid = (num_qb,)
+    out_d2, out_idx = pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, num_pb), lambda b: (b, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, num_pb), lambda b: (b, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, s_q, 3), lambda b: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, s_q), lambda b: (b, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((s_q, k), lambda b: (b, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((s_q, k), lambda b: (b, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=(
+            pl.BlockSpec((s_q, k), lambda b: (b, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((s_q, k), lambda b: (b, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            # under shard_map the outputs vary over the same mesh axes as the
+            # candidate state; outside, vma is empty and this is a no-op
+            jax.ShapeDtypeStruct((num_qb * s_q, k), jnp.float32,
+                                 vma=getattr(jax.typeof(in_d2), "vma",
+                                             frozenset())),
+            jax.ShapeDtypeStruct((num_qb * s_q, k), jnp.int32,
+                                 vma=getattr(jax.typeof(in_idx), "vma",
+                                             frozenset())),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, 3, t_p), jnp.float32),
+            pltpu.VMEM((2, 1, t_p), jnp.int32),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(order, boxd2, q_pts, q_ids, in_d2, in_idx, p_t, pid_t)
+    return out_d2, out_idx
+
+
+def knn_update_tiled_pallas(state: CandidateState, q: BucketedPoints,
+                            p: BucketedPoints, *,
+                            interpret: bool | None = None) -> CandidateState:
+    """Drop-in Pallas twin of ``ops.tiled.knn_update_tiled`` (same contract:
+    state rows in ``q``'s bucket order; folds every real point of ``p`` in)."""
+    if interpret is None:
+        from mpi_cuda_largescaleknn_tpu.ops.pallas import is_tpu_backend
+        interpret = not is_tpu_backend()
+    num_qb, s_q = q.ids.shape
+    k = state.dist2.shape[-1]
+
+    sorted_d2, order = nearest_first_order(q.lower, q.upper,
+                                           p.lower, p.upper)  # [Bq, Bp] x2
+
+    p_t = jnp.swapaxes(p.pts, 1, 2)           # [Bp, 3, T]
+    pid_t = p.ids[:, None, :]                 # [Bp, 1, T]
+
+    assert state.dist2.shape == (num_qb * s_q, k), (state.dist2.shape,
+                                                    (num_qb, s_q, k))
+    out_d2, out_idx = _run(order, sorted_d2, q.pts, q.ids, state.dist2,
+                           state.idx, p_t, pid_t, interpret=interpret)
+    return CandidateState(out_d2, out_idx)

@@ -135,6 +135,20 @@ def bucket_box_dist2(q_lower, q_upper, p_lower, p_upper) -> jnp.ndarray:
     return jnp.where(jnp.isnan(d2), jnp.inf, d2)
 
 
+def nearest_first_order(q_lower, q_upper, p_lower, p_upper):
+    """Per query bucket, point buckets in ascending box-distance order.
+
+    Returns ``(sorted_d2 f32[Bq, Bp], order i32[Bq, Bp])`` — the shared
+    visit schedule of the XLA and Pallas tiled engines (the traversal's
+    "close child first" rule made global; stable sort fixes tie order
+    identically in both twins).
+    """
+    box_d2 = bucket_box_dist2(q_lower, q_upper, p_lower, p_upper)
+    iota = jnp.broadcast_to(
+        jnp.arange(box_d2.shape[1], dtype=jnp.int32)[None, :], box_d2.shape)
+    return lax.sort((box_d2, iota), num_keys=1, dimension=1, is_stable=True)
+
+
 def scatter_back(values: jnp.ndarray, pos: jnp.ndarray, n_out: int,
                  fill=0) -> jnp.ndarray:
     """Scatter bucket-order ``values`` (any [B, S, ...]) back to input-row

@@ -35,7 +35,10 @@ from jax import lax
 
 from mpi_cuda_largescaleknn_tpu.core.types import CandidateState
 from mpi_cuda_largescaleknn_tpu.ops.candidates import merge_candidates
-from mpi_cuda_largescaleknn_tpu.ops.partition import BucketedPoints, bucket_box_dist2
+from mpi_cuda_largescaleknn_tpu.ops.partition import (
+    BucketedPoints,
+    nearest_first_order,
+)
 
 
 def _default_chunk(num_buckets: int, s: int, t: int,
@@ -73,11 +76,8 @@ def knn_update_tiled(state: CandidateState, q: BucketedPoints,
     assert num_qb % chunk == 0, (num_qb, chunk)
     n_chunks = num_qb // chunk
 
-    box_d2 = bucket_box_dist2(q.lower, q.upper, p.lower, p.upper)  # [Bq, Bp]
-    iota = jnp.broadcast_to(jnp.arange(num_pb, dtype=jnp.int32)[None, :],
-                            box_d2.shape)
-    sorted_d2, order = lax.sort((box_d2, iota), num_keys=1, dimension=1,
-                                is_stable=True)
+    sorted_d2, order = nearest_first_order(q.lower, q.upper,
+                                           p.lower, p.upper)  # [Bq, Bp] x2
 
     qvalid = q.ids >= 0
     hd2 = state.dist2.reshape(num_qb, s_q, k)

@@ -60,7 +60,10 @@ from mpi_cuda_largescaleknn_tpu.ops.partition import (
 )
 from mpi_cuda_largescaleknn_tpu.ops.tiled import knn_update_tiled
 from mpi_cuda_largescaleknn_tpu.parallel.mesh import AXIS, pvary
-from mpi_cuda_largescaleknn_tpu.parallel.ring import _engine_fn
+from mpi_cuda_largescaleknn_tpu.parallel.ring import (
+    _engine_fn,
+    _tiled_engine_fn,
+)
 
 
 def demand_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
@@ -76,8 +79,9 @@ def demand_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
     reference only exposes as per-round stdout prints (:306).
     """
     num_shards = mesh.shape[AXIS]
-    use_tiled = engine in ("tiled", "auto")
+    use_tiled = engine in ("tiled", "auto", "pallas_tiled")
     update = None if use_tiled else _engine_fn(engine, query_tile, point_tile)
+    tiled_update = _tiled_engine_fn(engine) if use_tiled else None
     use_tree = engine == "tree"
     fwd = [(i, (i + 1) % num_shards) for i in range(num_shards)]
 
@@ -142,8 +146,8 @@ def demand_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
                     resident = q._replace(
                         pts=shard_state[0], ids=shard_state[1],
                         lower=shard_state[2], upper=shard_state[3])
-                    st = knn_update_tiled(CandidateState(hd2, hidx), q,
-                                          resident)
+                    st = tiled_update(CandidateState(hd2, hidx), q,
+                                      resident)
                 else:
                     st = update(CandidateState(hd2, hidx), queries,
                                 *shard_state)
@@ -175,9 +179,11 @@ def demand_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
         return dists, hd2, hidx, pvary(rounds)[None], nrun[None]
 
     spec = P(AXIS)
+    # see ring.py: pallas engines need check_vma=False under shard_map
     mapped = jax.jit(jax.shard_map(
         body, mesh=mesh, in_specs=(spec, spec),
-        out_specs=(spec, spec, spec, spec, spec)))
+        out_specs=(spec, spec, spec, spec, spec),
+        check_vma=not engine.startswith("pallas")))
 
     sharding = NamedSharding(mesh, spec)
     points_sharded = jax.device_put(points_sharded, sharding)

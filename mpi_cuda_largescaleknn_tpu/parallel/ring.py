@@ -73,6 +73,21 @@ def _engine_fn(engine: str, query_tile: int, point_tile: int):
     raise ValueError(f"unknown engine '{engine}'")
 
 
+def _tiled_engine_fn(engine: str):
+    """Bucket-granular fold for the tiled data path: the fused Pallas
+    traversal kernel for ``pallas_tiled``, the XLA twin otherwise."""
+    if engine == "pallas_tiled":
+        try:
+            from mpi_cuda_largescaleknn_tpu.ops.pallas.knn_tiled import (
+                knn_update_tiled_pallas,
+            )
+        except ImportError as e:
+            raise ValueError(
+                "engine 'pallas_tiled' is unavailable in this build") from e
+        return knn_update_tiled_pallas
+    return knn_update_tiled
+
+
 def ring_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
              mesh, *, max_radius: float = jnp.inf, engine: str = "auto",
              query_tile: int = 2048, point_tile: int = 2048,
@@ -94,8 +109,9 @@ def ring_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
       padding rows), plus the CandidateState if ``return_candidates``.
     """
     num_shards = mesh.shape[AXIS]
-    use_tiled = engine in ("tiled", "auto")
+    use_tiled = engine in ("tiled", "auto", "pallas_tiled")
     update = None if use_tiled else _engine_fn(engine, query_tile, point_tile)
+    tiled_update = _tiled_engine_fn(engine) if use_tiled else None
     use_tree = engine == "tree"
     fwd = [(i, (i + 1) % num_shards) for i in range(num_shards)]
 
@@ -113,7 +129,7 @@ def ring_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
             nxt = jax.tree.map(lambda a: jax.lax.ppermute(a, AXIS, fwd), shard)
             resident = q._replace(pts=shard[0], ids=shard[1], lower=shard[2],
                                   upper=shard[3])
-            st = knn_update_tiled(CandidateState(hd2, hidx), q, resident)
+            st = tiled_update(CandidateState(hd2, hidx), q, resident)
             return nxt, st.dist2, st.idx
 
         _, hd2, hidx = jax.lax.fori_loop(
@@ -153,10 +169,14 @@ def ring_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
     body = body_tiled if use_tiled else body_flat
 
     shard_spec = P(AXIS)
+    # interpret-mode pallas kernels re-evaluate a vma-less kernel jaxpr with
+    # varying operands, which trips shard_map's vma checker (JAX's own
+    # guidance: pass check_vma=False); XLA engines keep the strict typing
     mapped = jax.jit(jax.shard_map(
         body, mesh=mesh,
         in_specs=(shard_spec, shard_spec),
-        out_specs=(shard_spec, shard_spec, shard_spec)))
+        out_specs=(shard_spec, shard_spec, shard_spec),
+        check_vma=not engine.startswith("pallas")))
 
     sharding = NamedSharding(mesh, shard_spec)
     points_sharded = jax.device_put(points_sharded, sharding)

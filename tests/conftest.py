@@ -22,6 +22,16 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Import the Pallas TPU lowerings while the tpu backend factory still exists:
+# register_lowering validates platforms against the currently-known backend
+# set, so this must precede the factory drop below. The kernels themselves
+# run in interpreter mode on CPU.
+try:
+    from jax.experimental.pallas import tpu as _pltpu  # noqa: E402,F401
+except Exception:
+    pass
+
 try:
     from jax._src import xla_bridge as _xb
 

@@ -1,0 +1,100 @@
+"""Pallas engine tests — run in interpreter mode on the CPU fixture.
+
+The same `knn_update_pallas` entry runs compiled on a real TPU; interpret mode
+checks the exact merge semantics (strict-< entry, radius bound, incremental
+adoption) against the oracle and the XLA brute-force twin.
+"""
+
+import numpy as np
+import pytest
+
+from mpi_cuda_largescaleknn_tpu.core.types import pad_points
+from mpi_cuda_largescaleknn_tpu.ops.brute_force import knn_update_bruteforce
+from mpi_cuda_largescaleknn_tpu.ops.candidates import (
+    extract_final_result,
+    init_candidates,
+)
+from mpi_cuda_largescaleknn_tpu.ops.pallas.knn_bf import knn_update_pallas
+
+from .oracle import assert_dist_equal, kth_nn_dist, random_points
+
+
+@pytest.mark.parametrize("n,k", [(100, 1), (300, 8), (520, 17)])
+def test_matches_oracle_self_query(n, k):
+    pts = random_points(n)
+    st = knn_update_pallas(init_candidates(n, k), pts, pts,
+                           query_tile=64, point_tile=128)
+    got = np.array(extract_final_result(st))
+    want = kth_nn_dist(pts, pts, k)
+    assert_dist_equal(got, want)
+
+
+def test_matches_xla_twin_distances():
+    pts = random_points(400, seed=2)
+    q = random_points(130, seed=3)
+    k = 9
+    pal = knn_update_pallas(init_candidates(130, k), q, pts,
+                            query_tile=64, point_tile=128)
+    xla = knn_update_bruteforce(init_candidates(130, k), q, pts,
+                                query_tile=64, point_tile=64)
+    np.testing.assert_allclose(np.array(pal.dist2), np.array(xla.dist2),
+                               rtol=1e-6)
+
+
+def test_k_greater_than_n_gives_inf():
+    pts = random_points(5)
+    st = knn_update_pallas(init_candidates(5, 8), pts, pts)
+    assert np.all(np.isinf(np.array(extract_final_result(st))))
+
+
+def test_max_radius_bound():
+    pts = random_points(260, seed=3)
+    k, r = 10, 0.05
+    st = knn_update_pallas(init_candidates(260, k, max_radius=r), pts, pts,
+                           query_tile=64, point_tile=128)
+    got = np.array(extract_final_result(st))
+    want = kth_nn_dist(pts, pts, k, max_radius=r)
+    assert_dist_equal(got, want)
+
+
+def test_incremental_rounds_equal_one_shot():
+    pts = random_points(384, seed=5)
+    q = random_points(96, seed=6)
+    k = 7
+    one = knn_update_pallas(init_candidates(96, k), q, pts,
+                            query_tile=32, point_tile=128)
+    st = init_candidates(96, k)
+    st = knn_update_pallas(st, q, pts[:150], query_tile=32, point_tile=128)
+    st = knn_update_pallas(st, q, pts[150:],
+                           point_ids=np.arange(150, 384, dtype=np.int32),
+                           query_tile=32, point_tile=128)
+    np.testing.assert_array_equal(np.array(one.dist2), np.array(st.dist2))
+    np.testing.assert_array_equal(np.array(one.idx), np.array(st.idx))
+
+
+def test_sentinel_padding_is_inert():
+    pts = random_points(100, seed=9)
+    padded, _ = pad_points(pts, 160)
+    k = 4
+    st_pad = knn_update_pallas(init_candidates(100, k), pts, padded,
+                               query_tile=32, point_tile=128)
+    st_ref = knn_update_pallas(init_candidates(100, k), pts, pts,
+                               query_tile=32, point_tile=128)
+    np.testing.assert_array_equal(np.array(st_pad.dist2), np.array(st_ref.dist2))
+
+
+def test_neighbor_ids_are_correct():
+    pts = random_points(200, seed=11)
+    k = 5
+    st = knn_update_pallas(init_candidates(200, k), pts, pts,
+                           query_tile=64, point_tile=128)
+    d2 = ((pts[:, None, :] - pts[None, :, :]) ** 2).sum(-1)
+    want_idx = np.argsort(d2, axis=1, kind="stable")[:, :k]
+    want_d = np.sort(d2, axis=1)[:, :k]
+    got_d = np.array(st.dist2)
+    np.testing.assert_allclose(got_d, want_d, rtol=1e-5, atol=1e-7)
+    # ids must point at rows whose distance equals the reported distance
+    got_idx = np.array(st.idx)
+    rows = np.arange(200)[:, None]
+    np.testing.assert_allclose(d2[rows, got_idx], got_d, rtol=1e-5, atol=1e-7)
+    del want_idx

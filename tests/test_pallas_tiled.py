@@ -1,0 +1,113 @@
+"""Bucketed-traversal Pallas kernel (ops/pallas/knn_tiled.py) vs oracle and
+vs its XLA twin — interpreter mode on the CPU fixture."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_cuda_largescaleknn_tpu.ops.candidates import (
+    extract_final_result,
+    init_candidates,
+)
+from mpi_cuda_largescaleknn_tpu.ops.pallas.knn_tiled import (
+    knn_update_tiled_pallas,
+)
+from mpi_cuda_largescaleknn_tpu.ops.partition import (
+    partition_points,
+    scatter_back,
+)
+from mpi_cuda_largescaleknn_tpu.ops.tiled import knn_update_tiled
+from tests.oracle import assert_dist_equal, kth_nn_dist, random_points
+
+
+def pallas_self_knn(pts, k, max_radius=np.inf, bucket_size=32):
+    q = partition_points(jnp.asarray(pts), bucket_size=bucket_size)
+    state = init_candidates(q.num_buckets * q.bucket_size, k, max_radius)
+    state = knn_update_tiled_pallas(state, q, q)
+    d = extract_final_result(state).reshape(q.num_buckets, q.bucket_size)
+    return np.asarray(scatter_back(d, q.pos, len(pts), fill=jnp.inf))
+
+
+@pytest.mark.parametrize("n,k", [(100, 1), (257, 8), (600, 13)])
+def test_matches_oracle(n, k):
+    pts = random_points(n, seed=n)
+    assert_dist_equal(pallas_self_knn(pts, k), kth_nn_dist(pts, pts, k))
+
+
+def test_k_exceeds_n_gives_inf():
+    pts = random_points(10, seed=1)
+    assert np.all(np.isinf(pallas_self_knn(pts, 32)))
+
+
+def test_max_radius_cutoff():
+    pts = random_points(300, seed=9, scale=4.0)
+    r = 0.35
+    want = kth_nn_dist(pts, pts, 6, max_radius=r)
+    assert_dist_equal(pallas_self_knn(pts, 6, max_radius=r), want)
+
+
+def test_clustered_data_pruning_is_safe():
+    rng = np.random.default_rng(11)
+    a = (rng.random((150, 3)) * 0.1).astype(np.float32)
+    b = (rng.random((150, 3)) * 0.1 + 50.0).astype(np.float32)
+    pts = np.concatenate([a, b]).astype(np.float32)
+    want = kth_nn_dist(pts, pts, 5)
+    assert_dist_equal(pallas_self_knn(pts, 5, bucket_size=16), want)
+
+
+def test_matches_xla_twin_exactly():
+    pts = random_points(500, seed=21)
+    k = 7
+    q = partition_points(jnp.asarray(pts), bucket_size=16)
+    init = init_candidates(q.num_buckets * q.bucket_size, k)
+    xla = knn_update_tiled(init, q, q)
+    pal = knn_update_tiled_pallas(init, q, q)
+    np.testing.assert_allclose(np.asarray(xla.dist2), np.asarray(pal.dist2),
+                               rtol=1e-6)
+
+
+def test_adoption_across_shards():
+    pts = random_points(300, seed=17)
+    a, b = pts[:151], pts[151:]
+    k = 9
+    q = partition_points(jnp.asarray(pts), bucket_size=16)
+    pa = partition_points(jnp.asarray(a), jnp.arange(151, dtype=jnp.int32),
+                          bucket_size=16)
+    pb = partition_points(jnp.asarray(b), jnp.arange(151, 300, dtype=jnp.int32),
+                          bucket_size=16)
+    state = init_candidates(q.num_buckets * q.bucket_size, k)
+    state = knn_update_tiled_pallas(state, q, pa)
+    state = knn_update_tiled_pallas(state, q, pb)
+    d = extract_final_result(state).reshape(q.num_buckets, q.bucket_size)
+    got = np.asarray(scatter_back(d, q.pos, len(pts), fill=jnp.inf))
+    assert_dist_equal(got, kth_nn_dist(pts, pts, k))
+
+
+def test_ring_pallas_tiled_8dev_matches_oracle():
+    import jax
+
+    from mpi_cuda_largescaleknn_tpu.core.config import KnnConfig
+    from mpi_cuda_largescaleknn_tpu.models.unordered import UnorderedKNN
+    from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+
+    pts = random_points(610, seed=23)
+    k = 6
+    cfg = KnnConfig(k=k, engine="pallas_tiled", bucket_size=16)
+    got = UnorderedKNN(cfg, mesh=get_mesh(len(jax.devices()))).run(pts)
+    assert_dist_equal(got, kth_nn_dist(pts, pts, k))
+
+
+def test_demand_pallas_tiled_matches_oracle():
+    from mpi_cuda_largescaleknn_tpu.core.config import KnnConfig
+    from mpi_cuda_largescaleknn_tpu.models.prepartitioned import (
+        PrePartitionedKNN,
+    )
+    from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+
+    pts = random_points(640, seed=31)
+    pts = pts[np.argsort(pts[:, 0], kind="stable")]
+    parts = [pts[i * 80:(i + 1) * 80] for i in range(8)]
+    cfg = KnnConfig(k=5, engine="pallas_tiled", bucket_size=16)
+    model = PrePartitionedKNN(cfg, mesh=get_mesh(8))
+    got = np.concatenate(model.run(parts))
+    assert_dist_equal(got, kth_nn_dist(pts, pts, 5))
