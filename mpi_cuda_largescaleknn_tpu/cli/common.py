@@ -26,6 +26,10 @@ TPU-side options (no reference analogue):
   --bucket-size N   points per spatial bucket (tiled engine; default 512)
   --profile-dir D   write a jax.profiler trace
   --timings         print phase timings as JSON to stderr
+  --checkpoint-dir D  (unordered pipeline only) snapshot ring state between
+                    rounds; an interrupted run relaunched with the same args
+                    resumes at the lost round
+  --checkpoint-every N  rounds between snapshots (default 1)
 """
 
 
@@ -45,7 +49,7 @@ def parse_args(program: str, argv: list[str]):
     out_path = ""
     extras = {"shards": None, "engine": "auto", "query_tile": 2048,
               "point_tile": 2048, "bucket_size": 512, "profile_dir": None,
-              "timings": False}
+              "timings": False, "checkpoint_dir": None, "checkpoint_every": 1}
     i = 0
     try:
         while i < len(argv):
@@ -74,6 +78,10 @@ def parse_args(program: str, argv: list[str]):
                 i += 1; extras["profile_dir"] = argv[i]
             elif arg == "--timings":
                 extras["timings"] = True
+            elif arg == "--checkpoint-dir":
+                i += 1; extras["checkpoint_dir"] = argv[i]
+            elif arg == "--checkpoint-every":
+                i += 1; extras["checkpoint_every"] = int(argv[i])
             else:
                 usage(program, f"unknown cmdline arg '{arg}'")
             i += 1
@@ -92,5 +100,7 @@ def parse_args(program: str, argv: list[str]):
                     point_tile=extras["point_tile"],
                     bucket_size=extras["bucket_size"],
                     num_shards=extras["shards"] or 0,
-                    profile_dir=extras["profile_dir"])
+                    profile_dir=extras["profile_dir"],
+                    checkpoint_dir=extras["checkpoint_dir"],
+                    checkpoint_every=extras["checkpoint_every"])
     return cfg, in_path, out_path, extras

@@ -31,6 +31,8 @@ class KnnConfig:
     bucket_size: int = 512           # tiled engine: points per spatial bucket
     num_shards: int = 1              # size of the 1-D mesh axis
     profile_dir: str | None = None   # jax.profiler trace output
+    checkpoint_dir: str | None = None  # ring-state checkpoint/resume
+    checkpoint_every: int = 1        # rounds between snapshots
     verbose: bool = False
 
     def validate(self) -> None:

@@ -25,6 +25,11 @@ class PrePartitionedKNN:
 
     def __init__(self, config: KnnConfig, mesh=None):
         config.validate()
+        if config.checkpoint_dir:
+            raise ValueError(
+                "checkpoint/resume is currently supported for the unordered "
+                "(ring) pipeline only; the demand engine's early-exit loop "
+                "is fused on-device and has no between-round host hook")
         self.config = config
         self.mesh = mesh if mesh is not None else get_mesh(
             config.num_shards if config.num_shards > 0 else None)

@@ -20,7 +20,10 @@ from mpi_cuda_largescaleknn_tpu.models.sharding import (
 )
 from mpi_cuda_largescaleknn_tpu.obs.timers import PhaseTimers
 from mpi_cuda_largescaleknn_tpu.parallel.mesh import AXIS, get_mesh
-from mpi_cuda_largescaleknn_tpu.parallel.ring import ring_knn
+from mpi_cuda_largescaleknn_tpu.parallel.ring import (
+    ring_knn,
+    ring_knn_stepwise,
+)
 
 
 class UnorderedKNN:
@@ -47,10 +50,18 @@ class UnorderedKNN:
 
         with self.timers.phase("ring", bytes_moved=(
                 num_shards * npad * 12 * num_shards)):  # tree bytes x rounds
-            dists = ring_knn(
-                flat, ids, cfg.k, self.mesh, max_radius=cfg.max_radius,
-                engine=cfg.engine, query_tile=cfg.query_tile,
-                point_tile=cfg.point_tile, bucket_size=cfg.bucket_size)
+            if cfg.checkpoint_dir:
+                dists = ring_knn_stepwise(
+                    flat, ids, cfg.k, self.mesh, max_radius=cfg.max_radius,
+                    engine=cfg.engine, query_tile=cfg.query_tile,
+                    point_tile=cfg.point_tile, bucket_size=cfg.bucket_size,
+                    checkpoint_dir=cfg.checkpoint_dir,
+                    checkpoint_every=cfg.checkpoint_every)
+            else:
+                dists = ring_knn(
+                    flat, ids, cfg.k, self.mesh, max_radius=cfg.max_radius,
+                    engine=cfg.engine, query_tile=cfg.query_tile,
+                    point_tile=cfg.point_tile, bucket_size=cfg.bucket_size)
             dists = np.asarray(dists)
 
         with self.timers.phase("extract"):

@@ -26,6 +26,11 @@ Deliberate improvements over the reference (not bugs to replicate):
   variant's pad-to-max trick (prePartitionedDataVariant.cu:251-266).
 - 64-bit-safe sizing throughout (the reference's ``int`` arithmetic overflows
   beyond ~2^31 bytes of candidates — SURVEY.md appendix).
+
+Two drivers share one set of per-round builders (``_make_ring_fns``): the
+fused ``ring_knn`` (whole ring in one ``lax.fori_loop`` — the default) and
+the host-stepped ``ring_knn_stepwise`` (one jitted step per round, enabling
+checkpoint/resume between rounds).
 """
 
 from __future__ import annotations
@@ -34,6 +39,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from mpi_cuda_largescaleknn_tpu.core.types import CandidateState
@@ -53,8 +59,9 @@ from mpi_cuda_largescaleknn_tpu.parallel.mesh import AXIS, pvary
 
 
 def _engine_fn(engine: str, query_tile: int, point_tile: int):
-    # flat-engine dispatch only; "auto"/"tiled" take the bucketed data path
-    # (body_tiled here, the q/shard_state branch in demand_knn) before this
+    # flat-engine dispatch only; "auto"/"tiled"/"pallas_tiled" take the
+    # bucketed data path (_make_ring_fns tiled branch, the q/shard_state
+    # branch in demand_knn) before this
     if engine == "bruteforce":
         return partial(knn_update_bruteforce, query_tile=query_tile,
                        point_tile=point_tile)
@@ -88,11 +95,79 @@ def _tiled_engine_fn(engine: str):
     return knn_update_tiled
 
 
+def _make_ring_fns(k, max_radius, engine, query_tile, point_tile, bucket_size,
+                   num_shards):
+    """(init_fn, round_fn, final_fn) — the per-round pieces both ring
+    drivers execute, defined once so the fused and stepwise paths cannot
+    diverge.
+
+    - init_fn(pts_local, ids_local) -> (stationary, shard, heap)
+    - round_fn(stationary, shard, heap) -> (next_shard, new_heap)
+      (issues the rotation before the fold so XLA overlaps them)
+    - final_fn(stationary, heap, npad) -> (dists, hd2, hidx) in input-row
+      order per shard
+    """
+    use_tiled = engine in ("tiled", "auto", "pallas_tiled")
+    fwd = [(i, (i + 1) % num_shards) for i in range(num_shards)]
+
+    if use_tiled:
+        tiled_update = _tiled_engine_fn(engine)
+
+        def init_fn(pts_local, ids_local):
+            q = partition_points(pts_local, ids_local,
+                                 bucket_size=bucket_size)
+            heap = pvary(init_candidates(q.num_buckets * q.bucket_size, k,
+                                         max_radius))
+            # the rotating "tree" = the bucketed shard + its bucket bounds;
+            # pos only matters query-side, so it does not ride the ring
+            shard = (q.pts, q.ids, q.lower, q.upper)
+            return q, shard, heap
+
+        def round_fn(q, shard, heap):
+            nxt = jax.tree.map(lambda a: jax.lax.ppermute(a, AXIS, fwd),
+                               shard)
+            resident = q._replace(pts=shard[0], ids=shard[1], lower=shard[2],
+                                  upper=shard[3])
+            return nxt, tiled_update(heap, q, resident)
+
+        def final_fn(q, heap, npad):
+            kk = heap.dist2.shape[-1]
+            bs = (q.num_buckets, q.bucket_size)
+            dists = scatter_back(extract_final_result(heap).reshape(bs),
+                                 q.pos, npad, fill=jnp.inf)
+            hd2 = scatter_back(heap.dist2.reshape(bs + (kk,)), q.pos, npad,
+                               fill=jnp.inf)
+            hidx = scatter_back(heap.idx.reshape(bs + (kk,)), q.pos, npad,
+                                fill=-1)
+            return dists, hd2, hidx
+    else:
+        update = _engine_fn(engine, query_tile, point_tile)
+        use_tree = engine == "tree"
+
+        def init_fn(pts_local, ids_local):
+            if use_tree:
+                shard = build_tree(pts_local, ids_local)
+            else:
+                shard = (pts_local, ids_local)
+            heap = pvary(init_candidates(pts_local.shape[0], k, max_radius))
+            return pts_local, shard, heap
+
+        def round_fn(queries, shard, heap):
+            nxt = jax.tree.map(lambda a: jax.lax.ppermute(a, AXIS, fwd),
+                               shard)
+            return nxt, update(heap, queries, shard[0], shard[1])
+
+        def final_fn(_queries, heap, _npad):
+            return extract_final_result(heap), heap.dist2, heap.idx
+
+    return init_fn, round_fn, final_fn
+
+
 def ring_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
              mesh, *, max_radius: float = jnp.inf, engine: str = "auto",
              query_tile: int = 2048, point_tile: int = 2048,
              bucket_size: int = 512, return_candidates: bool = False):
-    """Run the full R-round ring on a 1-D mesh.
+    """Run the full R-round ring on a 1-D mesh (fused ``lax.fori_loop``).
 
     Args:
       points_sharded: f32[R*Npad, 3], shard-major (device i owns rows
@@ -109,64 +184,22 @@ def ring_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
       padding rows), plus the CandidateState if ``return_candidates``.
     """
     num_shards = mesh.shape[AXIS]
-    use_tiled = engine in ("tiled", "auto", "pallas_tiled")
-    update = None if use_tiled else _engine_fn(engine, query_tile, point_tile)
-    tiled_update = _tiled_engine_fn(engine) if use_tiled else None
-    use_tree = engine == "tree"
-    fwd = [(i, (i + 1) % num_shards) for i in range(num_shards)]
+    init_fn, round_fn, final_fn = _make_ring_fns(
+        k, max_radius, engine, query_tile, point_tile, bucket_size,
+        num_shards)
 
-    def body_tiled(pts_local, ids_local):
-        npad = pts_local.shape[0]
-        q = partition_points(pts_local, ids_local, bucket_size=bucket_size)
-        heap = pvary(init_candidates(q.num_buckets * q.bucket_size, k,
-                                     max_radius))
-        # the rotating "tree" = the bucketed shard + its bucket bounds; pos
-        # only matters query-side, so it does not ride the ring
-        shard = (q.pts, q.ids, q.lower, q.upper)
+    def body(pts_local, ids_local):
+        stationary, shard, heap = init_fn(pts_local, ids_local)
 
         def round_body(_i, carry):
             shard, hd2, hidx = carry
-            nxt = jax.tree.map(lambda a: jax.lax.ppermute(a, AXIS, fwd), shard)
-            resident = q._replace(pts=shard[0], ids=shard[1], lower=shard[2],
-                                  upper=shard[3])
-            st = tiled_update(CandidateState(hd2, hidx), q, resident)
+            nxt, st = round_fn(stationary, shard, CandidateState(hd2, hidx))
             return nxt, st.dist2, st.idx
 
         _, hd2, hidx = jax.lax.fori_loop(
             0, num_shards, round_body, (shard, heap.dist2, heap.idx))
-        heap = CandidateState(hd2, hidx)
-        bs = (q.num_buckets, q.bucket_size)
-        dists = scatter_back(extract_final_result(heap).reshape(bs),
-                             q.pos, npad, fill=jnp.inf)
-        hd2 = scatter_back(heap.dist2.reshape(bs + (k,)), q.pos, npad,
-                           fill=jnp.inf)
-        hidx = scatter_back(heap.idx.reshape(bs + (k,)), q.pos, npad, fill=-1)
-        return dists, hd2, hidx
-
-    def body_flat(pts_local, ids_local):
-        queries = pts_local
-        if use_tree:
-            shard, shard_ids = build_tree(pts_local, ids_local)
-        else:
-            shard, shard_ids = pts_local, ids_local
-        heap = pvary(init_candidates(queries.shape[0], k, max_radius))
-
-        def round_body(_i, carry):
-            shard, shard_ids, hd2, hidx = carry
-            # issue the rotation first: the permute depends only on the
-            # resident shard, the update only reads it — XLA overlaps them
-            nxt = jax.lax.ppermute(shard, AXIS, fwd)
-            nxt_ids = jax.lax.ppermute(shard_ids, AXIS, fwd)
-            st = update(CandidateState(hd2, hidx), queries, shard, shard_ids)
-            return nxt, nxt_ids, st.dist2, st.idx
-
-        _, _, hd2, hidx = jax.lax.fori_loop(
-            0, num_shards, round_body,
-            (shard, shard_ids, heap.dist2, heap.idx))
-        heap = CandidateState(hd2, hidx)
-        return extract_final_result(heap), heap.dist2, heap.idx
-
-    body = body_tiled if use_tiled else body_flat
+        return final_fn(stationary, CandidateState(hd2, hidx),
+                        pts_local.shape[0])
 
     shard_spec = P(AXIS)
     # interpret-mode pallas kernels re-evaluate a vma-less kernel jaxpr with
@@ -185,3 +218,87 @@ def ring_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
     if return_candidates:
         return dists, CandidateState(hd2, hidx)
     return dists
+
+
+def ring_knn_stepwise(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
+                      k: int, mesh, *, max_radius: float = jnp.inf,
+                      engine: str = "auto", query_tile: int = 2048,
+                      point_tile: int = 2048, bucket_size: int = 512,
+                      checkpoint_dir: str | None = None,
+                      checkpoint_every: int = 1,
+                      max_rounds: int | None = None):
+    """``ring_knn`` with host-controlled rounds + checkpoint/resume.
+
+    Identical results to ``ring_knn`` (literally the same ``_make_ring_fns``
+    per-round pieces), but the round loop runs on the host — one jitted
+    shard_map step per round — so the persistent heaps and the resident
+    rotating shard can be snapshotted between rounds and a preempted run
+    resumed at the exact round it lost. The reference cannot do this (one
+    pass, output only at the end, SURVEY.md §5); its candidate buffer is the
+    natural checkpoint state and here it literally is the checkpoint.
+
+    The checkpoint fingerprint includes a sampled digest of the input data;
+    a successful full run clears its checkpoint so a later run cannot
+    silently reuse stale results. ``max_rounds`` stops early (state saved if
+    checkpointing), for staged runs and interruption tests.
+
+    Returns f32[R*Npad] k-th-NN distances (numpy), shard-major like
+    ``ring_knn``.
+    """
+    from mpi_cuda_largescaleknn_tpu.utils import checkpoint as ckpt
+
+    num_shards = mesh.shape[AXIS]
+    init_fn, round_fn, final_fn = _make_ring_fns(
+        k, max_radius, engine, query_tile, point_tile, bucket_size,
+        num_shards)
+    spec = P(AXIS)
+    check_vma = not engine.startswith("pallas")
+    npad_local = points_sharded.shape[0] // num_shards
+
+    def smap(fn, n_in, out_structs):
+        return jax.jit(jax.shard_map(
+            fn, mesh=mesh, in_specs=(spec,) * n_in, out_specs=out_structs,
+            check_vma=check_vma))
+
+    sharding = NamedSharding(mesh, spec)
+    pts = jax.device_put(points_sharded, sharding)
+    ids = jax.device_put(ids_sharded, sharding)
+
+    fp = None
+    if checkpoint_dir:
+        fp = ckpt.fingerprint(
+            n=int(pts.shape[0]), k=int(k), shards=num_shards, engine=engine,
+            max_radius=float(max_radius), bucket_size=bucket_size,
+            data=ckpt.data_digest(points_sharded, ids_sharded))
+
+    stationary, shard, heap = smap(init_fn, 2, (spec, spec, spec))(pts, ids)
+    step = smap(round_fn, 3, (spec, spec))
+
+    start = 0
+    if checkpoint_dir:
+        got = ckpt.load_ring_state(checkpoint_dir, fp)
+        if got is not None:
+            start, arrs = got
+            flat, treedef = jax.tree.flatten((shard, heap))
+            restored = [jax.device_put(arrs[f"a{i}"], sharding)
+                        for i in range(len(flat))]
+            shard, heap = jax.tree.unflatten(treedef, restored)
+
+    stop = num_shards if max_rounds is None else min(max_rounds, num_shards)
+    for r in range(start, stop):
+        shard, heap = step(stationary, shard, heap)
+        if checkpoint_dir and ((r + 1) % checkpoint_every == 0
+                               or r + 1 == stop):
+            flat, _ = jax.tree.flatten((shard, heap))
+            jax.block_until_ready(flat)
+            ckpt.save_ring_state(checkpoint_dir, r + 1,
+                                 {f"a{i}": a for i, a in enumerate(flat)}, fp)
+
+    dists, _hd2, _hidx = smap(
+        lambda s, h: final_fn(s, h, npad_local), 2,
+        (spec, spec, spec))(stationary, heap)
+    if checkpoint_dir and stop == num_shards:
+        # done: clear so a later (possibly different-data) run in the same
+        # dir can never resume past its own work
+        ckpt.clear(checkpoint_dir)
+    return np.asarray(dists)

@@ -1,0 +1,96 @@
+"""Ring-state checkpointing.
+
+The reference has NO checkpoint/resume: one pass, outputs written only at the
+end; a lost rank = a lost run (SURVEY.md §5, unorderedDataVariant.cu:229-237).
+Its candidate-list buffer is nevertheless a natural checkpointable state — the
+per-query heaps fully summarize all rounds folded so far — and this module
+adds that capability: after any ring round, (round index, heaps, resident
+rotating shard) pin the exact remaining work, so a preempted multi-hour run
+resumes instead of restarting.
+
+Crash-safety: everything (arrays, round index, config fingerprint) lives in
+ONE ``.npz`` written to a temp path and atomically renamed — there is no
+window where the round index and the arrays can disagree. The fingerprint
+includes a sampled digest of the input data, so resuming against edited
+inputs fails loudly instead of folding new queries into old heaps; a
+completed run clears its checkpoint (see ring_knn_stepwise) so stale results
+can never be replayed as fresh ones.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+import numpy as np
+
+_STATE = "ring_state.npz"
+
+
+def data_digest(*arrays, sample_bytes: int = 1 << 16) -> str:
+    """Content fingerprint of input arrays — SAMPLED, not exhaustive, so it
+    stays O(sample) for billion-point inputs: hashes shape+dtype, the first
+    and last ``sample_bytes``, and an even stride through the middle. Catches
+    any realistic "same shapes, different dataset" mixup."""
+    h = hashlib.sha256()
+    for a in arrays:
+        a = np.ascontiguousarray(np.asarray(a))
+        h.update(repr((a.shape, str(a.dtype))).encode())
+        b = a.reshape(-1).view(np.uint8)
+        if b.nbytes <= 3 * sample_bytes:
+            h.update(b.tobytes())
+        else:
+            h.update(b[:sample_bytes].tobytes())
+            h.update(b[-sample_bytes:].tobytes())
+            stride = max(1, b.nbytes // sample_bytes)
+            h.update(b[::stride].tobytes())
+    return h.hexdigest()
+
+
+def fingerprint(**kv) -> dict:
+    """Config identity a checkpoint is valid for (all jsonable scalars)."""
+    return {k: (v if isinstance(v, (int, str, bool)) else float(v))
+            for k, v in kv.items()}
+
+
+def save_ring_state(ckpt_dir: str, round_idx: int, arrays: dict,
+                    manifest: dict) -> None:
+    """Atomically persist ``arrays`` (name -> array) at ``round_idx``."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    # np.savez appends ".npz" to names lacking it — keep the suffix last
+    tmp = os.path.join(ckpt_dir, f".tmp.{os.getpid()}.{_STATE}")
+    np.savez(tmp,
+             __round__=np.int64(round_idx),
+             __fingerprint__=np.frombuffer(
+                 json.dumps(manifest, sort_keys=True).encode(), np.uint8),
+             **{k: np.asarray(v) for k, v in arrays.items()})
+    os.replace(tmp, os.path.join(ckpt_dir, _STATE))
+
+
+def load_ring_state(ckpt_dir: str, manifest: dict):
+    """Returns (round_idx, arrays dict) or None if absent.
+
+    Raises ValueError when a checkpoint exists but was written for a
+    different run configuration or different input data.
+    """
+    spath = os.path.join(ckpt_dir, _STATE)
+    if not os.path.exists(spath):
+        return None
+    with np.load(spath) as z:
+        saved_fp = json.loads(z["__fingerprint__"].tobytes().decode())
+        want_fp = json.loads(json.dumps(manifest, sort_keys=True))
+        if saved_fp != want_fp:
+            raise ValueError(
+                f"checkpoint at {ckpt_dir} was written for config "
+                f"{saved_fp}, not {want_fp}; remove it (or pass a different "
+                f"--checkpoint-dir) to start fresh")
+        rnd = int(z["__round__"])
+        return rnd, {k: z[k] for k in z.files
+                     if k not in ("__round__", "__fingerprint__")}
+
+
+def clear(ckpt_dir: str) -> None:
+    p = os.path.join(ckpt_dir, _STATE)
+    if os.path.exists(p):
+        os.remove(p)

@@ -1,0 +1,117 @@
+"""Checkpoint/resume of the stepwise ring (parallel/ring.py +
+utils/checkpoint.py) — a capability the reference lacks (SURVEY.md §5)."""
+
+import numpy as np
+import pytest
+
+from mpi_cuda_largescaleknn_tpu.core.config import KnnConfig
+from mpi_cuda_largescaleknn_tpu.models.sharding import pad_and_flatten, slab_bounds
+from mpi_cuda_largescaleknn_tpu.models.unordered import UnorderedKNN
+from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+from mpi_cuda_largescaleknn_tpu.parallel.ring import ring_knn, ring_knn_stepwise
+from mpi_cuda_largescaleknn_tpu.utils import checkpoint as ckpt
+from tests.oracle import assert_dist_equal, kth_nn_dist, random_points
+
+
+def _sharded(pts, num_shards):
+    bounds = slab_bounds(len(pts), num_shards)
+    shards = [pts[b:e] for b, e in bounds]
+    flat, ids, counts, npad = pad_and_flatten(
+        shards, id_bases=[b for b, _ in bounds])
+    return flat, ids, counts, npad
+
+
+def test_stepwise_matches_fused():
+    pts = random_points(520, seed=3)
+    mesh = get_mesh(8)
+    flat, ids, _, _ = _sharded(pts, 8)
+    fused = np.asarray(ring_knn(flat, ids, 6, mesh, bucket_size=16))
+    stepwise = ring_knn_stepwise(flat, ids, 6, mesh, bucket_size=16)
+    np.testing.assert_array_equal(fused, stepwise)
+
+
+def test_stepwise_flat_engine_matches_fused():
+    pts = random_points(300, seed=5)
+    mesh = get_mesh(8)
+    flat, ids, _, _ = _sharded(pts, 8)
+    fused = np.asarray(ring_knn(flat, ids, 4, mesh, engine="bruteforce",
+                                query_tile=64, point_tile=64))
+    stepwise = ring_knn_stepwise(flat, ids, 4, mesh, engine="bruteforce",
+                                 query_tile=64, point_tile=64)
+    np.testing.assert_array_equal(fused, stepwise)
+
+
+def test_resume_from_partial_checkpoint(tmp_path):
+    """Die after round 3 of 8; a fresh run resumes there and matches
+    the uninterrupted result bit-for-bit."""
+    pts = random_points(480, seed=7)
+    mesh = get_mesh(8)
+    flat, ids, _, _ = _sharded(pts, 8)
+    cdir = str(tmp_path / "ck")
+    want = ring_knn_stepwise(flat, ids, 5, mesh, bucket_size=16)
+
+    # interrupted run: only 3 of 8 rounds execute before the "crash"
+    partial = ring_knn_stepwise(flat, ids, 5, mesh, bucket_size=16,
+                                checkpoint_dir=cdir, max_rounds=3)
+    fp = ckpt.fingerprint(n=int(flat.shape[0]), k=5, shards=8, engine="auto",
+                          max_radius=float(np.inf), bucket_size=16,
+                          data=ckpt.data_digest(flat, ids))
+    rnd, _arrs = ckpt.load_ring_state(cdir, fp)
+    assert rnd == 3
+    # 3 rounds cannot have visited all shards: partial must differ from final
+    assert not np.array_equal(partial, want)
+
+    # relaunch with the same args: resumes at round 3, replays 3..7
+    resumed = ring_knn_stepwise(flat, ids, 5, mesh, bucket_size=16,
+                                checkpoint_dir=cdir)
+    np.testing.assert_array_equal(resumed, want)
+    # a completed run clears its checkpoint: nothing left to resume from
+    assert ckpt.load_ring_state(cdir, fp) is None
+
+
+def test_checkpoint_fingerprint_mismatch_raises(tmp_path):
+    pts = random_points(160, seed=9)
+    mesh = get_mesh(8)
+    flat, ids, _, _ = _sharded(pts, 8)
+    cdir = str(tmp_path / "ck")
+    # partial checkpoint at k=4 on disk...
+    ring_knn_stepwise(flat, ids, 4, mesh, bucket_size=16,
+                      checkpoint_dir=cdir, max_rounds=2)
+    # ...must refuse to resume a k=5 run
+    with pytest.raises(ValueError, match="checkpoint"):
+        ring_knn_stepwise(flat, ids, 5, mesh, bucket_size=16,
+                          checkpoint_dir=cdir)
+
+
+def test_checkpoint_data_change_raises(tmp_path):
+    """Resuming against edited input data must fail loudly, not fold new
+    queries into old heaps (the data digest in the fingerprint)."""
+    pts = random_points(160, seed=13)
+    mesh = get_mesh(8)
+    flat, ids, _, _ = _sharded(pts, 8)
+    cdir = str(tmp_path / "ck")
+    ring_knn_stepwise(flat, ids, 4, mesh, bucket_size=16,
+                      checkpoint_dir=cdir, max_rounds=2)
+    other = np.array(flat)
+    other[3, 0] += 0.25  # same shape, different data
+    with pytest.raises(ValueError, match="checkpoint"):
+        ring_knn_stepwise(other, ids, 4, mesh, bucket_size=16,
+                          checkpoint_dir=cdir)
+
+
+def test_prepartitioned_checkpoint_rejected():
+    from mpi_cuda_largescaleknn_tpu.models.prepartitioned import (
+        PrePartitionedKNN,
+    )
+
+    with pytest.raises(ValueError, match="unordered"):
+        PrePartitionedKNN(KnnConfig(k=3, checkpoint_dir="/tmp/x"),
+                          mesh=get_mesh(8))
+
+
+def test_model_level_checkpoint_and_oracle(tmp_path):
+    pts = random_points(420, seed=11)
+    k = 5
+    cfg = KnnConfig(k=k, bucket_size=16, checkpoint_dir=str(tmp_path / "m"))
+    got = UnorderedKNN(cfg, mesh=get_mesh(8)).run(pts)
+    assert_dist_equal(got, kth_nn_dist(pts, pts, k))
