@@ -30,6 +30,11 @@ TPU-side options (no reference analogue):
                     rounds; an interrupted run relaunched with the same args
                     resumes at the lost round
   --checkpoint-every N  rounds between snapshots (default 1)
+  --write-indices P  also write the k neighbor IDs per point (int32, ascending
+                    by distance, -1 = fewer than k found): unordered -> one
+                    file P in global point order; prepartitioned -> one
+                    P_%06d.int32 per shard. The reference computes these but
+                    discards them (unorderedDataVariant.cu extractFinalResult)
 """
 
 
@@ -49,7 +54,8 @@ def parse_args(program: str, argv: list[str]):
     out_path = ""
     extras = {"shards": None, "engine": "auto", "query_tile": 2048,
               "point_tile": 2048, "bucket_size": 512, "profile_dir": None,
-              "timings": False, "checkpoint_dir": None, "checkpoint_every": 1}
+              "timings": False, "checkpoint_dir": None, "checkpoint_every": 1,
+              "write_indices": None}
     i = 0
     try:
         while i < len(argv):
@@ -82,6 +88,8 @@ def parse_args(program: str, argv: list[str]):
                 i += 1; extras["checkpoint_dir"] = argv[i]
             elif arg == "--checkpoint-every":
                 i += 1; extras["checkpoint_every"] = int(argv[i])
+            elif arg == "--write-indices":
+                i += 1; extras["write_indices"] = argv[i]
             else:
                 usage(program, f"unknown cmdline arg '{arg}'")
             i += 1

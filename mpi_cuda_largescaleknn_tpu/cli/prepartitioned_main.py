@@ -17,7 +17,10 @@ import sys
 
 from mpi_cuda_largescaleknn_tpu.cli.common import parse_args
 from mpi_cuda_largescaleknn_tpu.io.reader import read_list_of_file_names, read_points
-from mpi_cuda_largescaleknn_tpu.io.writer import write_rank_file
+from mpi_cuda_largescaleknn_tpu.io.writer import (
+    write_rank_file,
+    write_rank_indices,
+)
 from mpi_cuda_largescaleknn_tpu.models.prepartitioned import PrePartitionedKNN
 from mpi_cuda_largescaleknn_tpu.obs.trace import profile_trace
 from mpi_cuda_largescaleknn_tpu.parallel.mesh import AXIS, get_mesh
@@ -38,10 +41,14 @@ def main(argv: list[str] | None = None) -> int:
         print(f"#{r}/{len(partitions)}: got {len(p)} points to work on")
 
     model = PrePartitionedKNN(cfg, mesh=mesh)
+    want_idx = extras["write_indices"] is not None
     with profile_trace(cfg.profile_dir):
-        results = model.run(partitions)
+        got = model.run(partitions, return_neighbors=want_idx)
+    results, idx_lists = got if want_idx else (got, None)
     for r, dists in enumerate(results):
         write_rank_file(out_prefix, r, dists)
+        if want_idx:
+            write_rank_indices(extras["write_indices"], r, idx_lists[r])
     print("done all queries...")
     if extras["timings"]:
         sys.stderr.write(model.timers.dump() + "\n")

@@ -16,7 +16,7 @@ import sys
 
 from mpi_cuda_largescaleknn_tpu.cli.common import parse_args
 from mpi_cuda_largescaleknn_tpu.io.reader import read_file_portion
-from mpi_cuda_largescaleknn_tpu.io.writer import write_distances
+from mpi_cuda_largescaleknn_tpu.io.writer import write_distances, write_indices
 from mpi_cuda_largescaleknn_tpu.models.unordered import UnorderedKNN
 from mpi_cuda_largescaleknn_tpu.obs.trace import profile_trace
 from mpi_cuda_largescaleknn_tpu.parallel.mesh import AXIS, get_mesh
@@ -32,8 +32,14 @@ def main(argv: list[str] | None = None) -> int:
           f"got {n_total} points to work on")
 
     model = UnorderedKNN(cfg, mesh=mesh)
+    want_idx = extras["write_indices"] is not None
     with profile_trace(cfg.profile_dir):
-        dists = model.run(points)
+        got = model.run(points, return_neighbors=want_idx)
+    if want_idx:
+        dists, idx = got
+        write_indices(extras["write_indices"], idx)
+    else:
+        dists = got
     write_distances(out_path, dists)
     print("done all queries...")
     if extras["timings"]:

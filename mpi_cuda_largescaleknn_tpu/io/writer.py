@@ -24,3 +24,14 @@ def write_rank_file(prefix: str, rank: int, distances: np.ndarray) -> str:
     path = f"{prefix}_{rank:06d}.float"
     np.asarray(distances, np.float32).tofile(path)
     return path
+
+
+def write_indices(path: str, idx: np.ndarray) -> None:
+    """Row-major i32[N, k] neighbor ids (-1 = fewer than k found)."""
+    np.asarray(idx, np.int32).tofile(path)
+
+
+def write_rank_indices(prefix: str, rank: int, idx: np.ndarray) -> str:
+    path = f"{prefix}_{rank:06d}.int32"
+    np.asarray(idx, np.int32).tofile(path)
+    return path

@@ -36,9 +36,15 @@ class PrePartitionedKNN:
         self.timers = PhaseTimers()
         self.last_stats: dict | None = None
 
-    def run(self, partitions: list[np.ndarray]) -> list[np.ndarray]:
+    def run(self, partitions: list[np.ndarray],
+            return_neighbors: bool = False):
         """partitions: one f32[Ni,3] array per device -> per-partition f32[Ni]
-        k-th-NN distances (global over the union of all partitions)."""
+        k-th-NN distances (global over the union of all partitions).
+
+        With ``return_neighbors`` also returns per-partition i32[Ni, k]
+        neighbor ids, globally numbered by partition concatenation order
+        (-1 where fewer than k neighbors exist).
+        """
         cfg = self.config
         num_shards = self.mesh.shape[AXIS]
         if len(partitions) != num_shards:
@@ -49,10 +55,12 @@ class PrePartitionedKNN:
                 f"match mesh size ({num_shards})")
 
         with self.timers.phase("pad"):
-            flat, ids, counts, npad = pad_and_flatten(partitions)
+            sizes = np.cumsum([0] + [len(p) for p in partitions])
+            flat, ids, counts, npad = pad_and_flatten(
+                partitions, id_bases=list(sizes[:-1]))
 
         with self.timers.phase("demand_ring"):
-            dists, _cands, stats = demand_knn(
+            dists, cands, stats = demand_knn(
                 flat, ids, cfg.k, self.mesh, max_radius=cfg.max_radius,
                 engine=cfg.engine, query_tile=cfg.query_tile,
                 point_tile=cfg.point_tile, bucket_size=cfg.bucket_size,
@@ -64,4 +72,8 @@ class PrePartitionedKNN:
             }
 
         with self.timers.phase("extract"):
-            return trim_per_shard(dists, counts, npad)
+            out = trim_per_shard(dists, counts, npad)
+            if return_neighbors:
+                idx = trim_per_shard(np.asarray(cands.idx), counts, npad)
+                return out, idx
+            return out

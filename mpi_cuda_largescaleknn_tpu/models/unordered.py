@@ -36,8 +36,16 @@ class UnorderedKNN:
             config.num_shards if config.num_shards > 0 else None)
         self.timers = PhaseTimers()
 
-    def run(self, points: np.ndarray) -> np.ndarray:
-        """points f32[N,3] -> f32[N] distance of each point to its k-th NN."""
+    def run(self, points: np.ndarray, return_neighbors: bool = False):
+        """points f32[N,3] -> f32[N] distance of each point to its k-th NN.
+
+        With ``return_neighbors`` also returns i32[N, k] global neighbor ids
+        (ascending by distance; -1 where fewer than k neighbors exist, e.g.
+        under ``-r``) — a capability the reference computes but discards
+        (the packed u64 entries at unorderedDataVariant.cu:163-168 hold ids
+        that extractFinalResult never reads). Ids are int32: datasets beyond
+        2^31 points need the distance-only path.
+        """
         cfg = self.config
         num_shards = self.mesh.shape[AXIS]
         n_total = len(points)
@@ -48,22 +56,33 @@ class UnorderedKNN:
             flat, ids, counts, npad = pad_and_flatten(
                 shards, id_bases=[b for b, _ in bounds])
 
+        cands = None
         with self.timers.phase("ring", bytes_moved=(
                 num_shards * npad * 12 * num_shards)):  # tree bytes x rounds
             if cfg.checkpoint_dir:
-                dists = ring_knn_stepwise(
+                got = ring_knn_stepwise(
                     flat, ids, cfg.k, self.mesh, max_radius=cfg.max_radius,
                     engine=cfg.engine, query_tile=cfg.query_tile,
                     point_tile=cfg.point_tile, bucket_size=cfg.bucket_size,
                     checkpoint_dir=cfg.checkpoint_dir,
-                    checkpoint_every=cfg.checkpoint_every)
+                    checkpoint_every=cfg.checkpoint_every,
+                    return_candidates=return_neighbors)
             else:
-                dists = ring_knn(
+                got = ring_knn(
                     flat, ids, cfg.k, self.mesh, max_radius=cfg.max_radius,
                     engine=cfg.engine, query_tile=cfg.query_tile,
-                    point_tile=cfg.point_tile, bucket_size=cfg.bucket_size)
+                    point_tile=cfg.point_tile, bucket_size=cfg.bucket_size,
+                    return_candidates=return_neighbors)
+            if return_neighbors:
+                dists, cands = got
+            else:
+                dists = got
             dists = np.asarray(dists)
 
         with self.timers.phase("extract"):
             out = np.concatenate(trim_per_shard(dists, counts, npad))
+            if return_neighbors:
+                idx = np.concatenate(
+                    trim_per_shard(np.asarray(cands.idx), counts, npad))
+                return out, idx
         return out

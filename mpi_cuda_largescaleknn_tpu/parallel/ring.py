@@ -226,7 +226,8 @@ def ring_knn_stepwise(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
                       point_tile: int = 2048, bucket_size: int = 512,
                       checkpoint_dir: str | None = None,
                       checkpoint_every: int = 1,
-                      max_rounds: int | None = None):
+                      max_rounds: int | None = None,
+                      return_candidates: bool = False):
     """``ring_knn`` with host-controlled rounds + checkpoint/resume.
 
     Identical results to ``ring_knn`` (literally the same ``_make_ring_fns``
@@ -294,11 +295,13 @@ def ring_knn_stepwise(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
             ckpt.save_ring_state(checkpoint_dir, r + 1,
                                  {f"a{i}": a for i, a in enumerate(flat)}, fp)
 
-    dists, _hd2, _hidx = smap(
+    dists, hd2, hidx = smap(
         lambda s, h: final_fn(s, h, npad_local), 2,
         (spec, spec, spec))(stationary, heap)
     if checkpoint_dir and stop == num_shards:
         # done: clear so a later (possibly different-data) run in the same
         # dir can never resume past its own work
         ckpt.clear(checkpoint_dir)
+    if return_candidates:
+        return np.asarray(dists), CandidateState(hd2, hidx)
     return np.asarray(dists)

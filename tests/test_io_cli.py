@@ -104,3 +104,49 @@ def test_cli_radius_flag(tmp_path):
     assert rc == 0
     got = np.fromfile(out_path, np.float32)
     assert_dist_equal(got, kth_nn_dist(pts, pts, 10, max_radius=0.05))
+
+
+class TestWriteIndices:
+    def test_unordered_write_indices(self, tmp_path):
+        rng = np.random.default_rng(3)
+        pts = rng.random((300, 3)).astype(np.float32)
+        inp = tmp_path / "p.float3"
+        pts.tofile(inp)
+        out = tmp_path / "d.float"
+        idxp = tmp_path / "i.int32"
+        unordered_main([str(inp), "-o", str(out), "-k", "4",
+                        "--shards", "4", "--write-indices", str(idxp)])
+        idx = np.fromfile(idxp, np.int32).reshape(300, 4)
+        d = np.fromfile(out, np.float32)
+        full = ((pts[:, None, :] - pts[None, :, :]) ** 2).sum(-1)
+        rows = np.arange(300)
+        # reported ids must realize the reported k-th distance
+        np.testing.assert_allclose(
+            np.sqrt(full[rows, idx[:, -1]]), d, rtol=1e-6)
+        # first neighbor of a point in a self-query is itself
+        assert np.array_equal(idx[:, 0], rows)
+
+    def test_prepartitioned_write_indices(self, tmp_path):
+        rng = np.random.default_rng(5)
+        pts = rng.random((320, 3)).astype(np.float32)
+        pts = pts[np.argsort(pts[:, 0], kind="stable")]
+        names = []
+        for i in range(8):
+            f = tmp_path / f"part{i}.float3"
+            pts[i * 40:(i + 1) * 40].tofile(f)
+            names.append(str(f))
+        lst = tmp_path / "files.txt"
+        lst.write_text("\n".join(names) + "\n")
+        prepart_main([str(lst), "-o", str(tmp_path / "o"), "-k", "3",
+                      "--write-indices", str(tmp_path / "i")])
+        idx = np.concatenate([
+            np.fromfile(tmp_path / f"i_{r:06d}.int32", np.int32).reshape(-1, 3)
+            for r in range(8)])
+        d = np.concatenate([
+            np.fromfile(tmp_path / f"o_{r:06d}.float", np.float32)
+            for r in range(8)])
+        full = ((pts[:, None, :] - pts[None, :, :]) ** 2).sum(-1)
+        rows = np.arange(320)
+        np.testing.assert_allclose(
+            np.sqrt(full[rows, idx[:, -1]]), d, rtol=1e-6)
+        assert np.array_equal(idx[:, 0], rows)  # global ids, self first
