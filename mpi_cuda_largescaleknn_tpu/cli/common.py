@@ -24,6 +24,9 @@ TPU-side options (no reference analogue):
   --query-tile N    queries per inner tile (flat engines; default 2048)
   --point-tile N    tree points per inner tile (flat engines; default 2048)
   --bucket-size N   points per spatial bucket (tiled engine; default 512)
+  --query-chunk N   (unordered) stream queries in chunks of N rows per device;
+                    bounds candidate-heap memory to N*k per device for runs
+                    whose heaps exceed HBM (e.g. -k 100 at 100M+ points)
   --profile-dir D   write a jax.profiler trace
   --timings         print phase timings as JSON to stderr
   --checkpoint-dir D  (unordered pipeline only) snapshot ring state between
@@ -55,7 +58,7 @@ def parse_args(program: str, argv: list[str]):
     extras = {"shards": None, "engine": "auto", "query_tile": 2048,
               "point_tile": 2048, "bucket_size": 512, "profile_dir": None,
               "timings": False, "checkpoint_dir": None, "checkpoint_every": 1,
-              "write_indices": None}
+              "write_indices": None, "query_chunk": 0}
     i = 0
     try:
         while i < len(argv):
@@ -90,6 +93,8 @@ def parse_args(program: str, argv: list[str]):
                 i += 1; extras["checkpoint_every"] = int(argv[i])
             elif arg == "--write-indices":
                 i += 1; extras["write_indices"] = argv[i]
+            elif arg == "--query-chunk":
+                i += 1; extras["query_chunk"] = int(argv[i])
             else:
                 usage(program, f"unknown cmdline arg '{arg}'")
             i += 1
@@ -108,6 +113,7 @@ def parse_args(program: str, argv: list[str]):
                     point_tile=extras["point_tile"],
                     bucket_size=extras["bucket_size"],
                     num_shards=extras["shards"] or 0,
+                    query_chunk=extras["query_chunk"],
                     profile_dir=extras["profile_dir"],
                     checkpoint_dir=extras["checkpoint_dir"],
                     checkpoint_every=extras["checkpoint_every"])

@@ -30,6 +30,10 @@ class KnnConfig:
     point_tile: int = 2048           # tree points per inner tile
     bucket_size: int = 512           # tiled engine: points per spatial bucket
     num_shards: int = 1              # size of the 1-D mesh axis
+    query_chunk: int = 0             # >0: stream queries in chunks of this
+                                     # many rows/device (bounds heap memory
+                                     # to chunk*k per device — the k=100 /
+                                     # beyond-HBM regime)
     profile_dir: str | None = None   # jax.profiler trace output
     checkpoint_dir: str | None = None  # ring-state checkpoint/resume
     checkpoint_every: int = 1        # rounds between snapshots

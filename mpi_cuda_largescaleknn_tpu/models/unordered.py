@@ -22,6 +22,7 @@ from mpi_cuda_largescaleknn_tpu.obs.timers import PhaseTimers
 from mpi_cuda_largescaleknn_tpu.parallel.mesh import AXIS, get_mesh
 from mpi_cuda_largescaleknn_tpu.parallel.ring import (
     ring_knn,
+    ring_knn_chunked,
     ring_knn_stepwise,
 )
 
@@ -57,9 +58,21 @@ class UnorderedKNN:
                 shards, id_bases=[b for b, _ in bounds])
 
         cands = None
+        # tree bytes x rounds; the chunked path rotates a full ring per chunk
+        n_chunks = (max(1, -(-npad // cfg.query_chunk))
+                    if cfg.query_chunk > 0 else 1)
         with self.timers.phase("ring", bytes_moved=(
-                num_shards * npad * 12 * num_shards)):  # tree bytes x rounds
-            if cfg.checkpoint_dir:
+                num_shards * npad * 12 * num_shards * n_chunks)):
+            if cfg.query_chunk > 0:
+                got = ring_knn_chunked(
+                    flat, ids, cfg.k, self.mesh, max_radius=cfg.max_radius,
+                    engine=cfg.engine, query_tile=cfg.query_tile,
+                    point_tile=cfg.point_tile, bucket_size=cfg.bucket_size,
+                    chunk_rows=cfg.query_chunk,
+                    checkpoint_dir=cfg.checkpoint_dir,
+                    checkpoint_every=cfg.checkpoint_every,
+                    return_candidates=return_neighbors)
+            elif cfg.checkpoint_dir:
                 got = ring_knn_stepwise(
                     flat, ids, cfg.k, self.mesh, max_radius=cfg.max_radius,
                     engine=cfg.engine, query_tile=cfg.query_tile,

@@ -1,0 +1,77 @@
+"""Chunked-query streaming ring (ring_knn_chunked) — the beyond-HBM heap
+regime (SURVEY.md §7 hard part #4). Heaps live only for the active chunk;
+tree shards stay resident and rotate a full ring per chunk."""
+
+import numpy as np
+import pytest
+
+from mpi_cuda_largescaleknn_tpu.core.config import KnnConfig
+from mpi_cuda_largescaleknn_tpu.models.unordered import UnorderedKNN
+from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+from mpi_cuda_largescaleknn_tpu.parallel.ring import (
+    ring_knn,
+    ring_knn_chunked,
+)
+from tests.oracle import assert_dist_equal, kth_nn_dist, random_points
+from tests.test_checkpoint import _sharded
+
+
+@pytest.mark.parametrize("chunk_rows", [16, 23, 64, 100])
+def test_chunked_matches_fused(chunk_rows):
+    """Any chunk size — even ones that split unevenly — reproduces the
+    one-shot ring bit-for-bit."""
+    pts = random_points(520, seed=3)
+    mesh = get_mesh(8)
+    flat, ids, _, _ = _sharded(pts, 8)
+    fused = np.asarray(ring_knn(flat, ids, 6, mesh, bucket_size=16))
+    chunked = ring_knn_chunked(flat, ids, 6, mesh, chunk_rows=chunk_rows,
+                               bucket_size=16)
+    np.testing.assert_array_equal(fused, chunked)
+
+
+def test_chunked_with_candidates():
+    pts = random_points(256, seed=5)
+    mesh = get_mesh(8)
+    flat, ids, _, _ = _sharded(pts, 8)
+    _, cands = ring_knn_chunked(flat, ids, 4, mesh, chunk_rows=16,
+                                bucket_size=16, return_candidates=True)
+    _, want = ring_knn(flat, ids, 4, mesh, bucket_size=16,
+                       return_candidates=True)
+    np.testing.assert_array_equal(np.asarray(want.dist2), cands.dist2)
+
+
+def test_chunked_resume(tmp_path):
+    """Die after 2 of 4 chunks; relaunch completes only the remaining
+    chunks and matches the uninterrupted result."""
+    pts = random_points(512, seed=7)
+    mesh = get_mesh(8)
+    flat, ids, _, _ = _sharded(pts, 8)
+    cdir = str(tmp_path / "ck")
+    want = ring_knn_chunked(flat, ids, 5, mesh, chunk_rows=16,
+                            bucket_size=16)
+    partial = ring_knn_chunked(flat, ids, 5, mesh, chunk_rows=16,
+                               bucket_size=16, checkpoint_dir=cdir,
+                               max_chunks=2)
+    assert not np.array_equal(partial, want)  # later chunks still inf
+    resumed = ring_knn_chunked(flat, ids, 5, mesh, chunk_rows=16,
+                               bucket_size=16, checkpoint_dir=cdir)
+    np.testing.assert_array_equal(resumed, want)
+
+
+def test_model_level_chunked_oracle():
+    pts = random_points(430, seed=11)
+    k = 7
+    cfg = KnnConfig(k=k, bucket_size=16, query_chunk=16)
+    got = UnorderedKNN(cfg, mesh=get_mesh(8)).run(pts)
+    assert_dist_equal(got, kth_nn_dist(pts, pts, k))
+
+
+def test_model_level_chunked_neighbors():
+    pts = random_points(200, seed=13)
+    cfg = KnnConfig(k=3, bucket_size=16, query_chunk=16)
+    d, idx = UnorderedKNN(cfg, mesh=get_mesh(8)).run(
+        pts, return_neighbors=True)
+    full = ((pts[:, None, :] - pts[None, :, :]) ** 2).sum(-1)
+    rows = np.arange(200)
+    np.testing.assert_allclose(np.sqrt(full[rows, idx[:, -1]]), d, rtol=1e-6)
+    assert np.array_equal(idx[:, 0], rows)
