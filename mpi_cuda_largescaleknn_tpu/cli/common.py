@@ -29,9 +29,9 @@ TPU-side options (no reference analogue):
                     whose heaps exceed HBM (e.g. -k 100 at 100M+ points)
   --profile-dir D   write a jax.profiler trace
   --timings         print phase timings as JSON to stderr
-  --checkpoint-dir D  (unordered pipeline only) snapshot ring state between
-                    rounds; an interrupted run relaunched with the same args
-                    resumes at the lost round
+  --checkpoint-dir D  snapshot exchange state between rounds (both
+                    pipelines); an interrupted run relaunched with the same
+                    args resumes at the lost round
   --checkpoint-every N  rounds between snapshots (default 1)
   --write-indices P  also write the k neighbor IDs per point (int32, ascending
                     by distance, -1 = fewer than k found): unordered -> one

@@ -48,8 +48,25 @@ def _load():
                                      ctypes.c_int64, ctypes.c_void_p]
         lib.lsk_file_size.restype = ctypes.c_int64
         lib.lsk_file_size.argtypes = [ctypes.c_char_p]
+        lib.lsk_partition.restype = ctypes.c_int64
+        lib.lsk_partition.argtypes = [ctypes.c_char_p, ctypes.c_int32,
+                                      ctypes.c_char_p, ctypes.c_int32,
+                                      ctypes.c_void_p]
         _lib = lib
     return _lib
+
+
+def available() -> bool:
+    """True when the native library can be compiled/loaded on this machine.
+
+    Distinguishes "no toolchain" (callers may fall back to numpy) from a
+    native call that ran and FAILED (callers must surface that error, not
+    silently retry in-memory)."""
+    try:
+        _load()
+        return True
+    except Exception:
+        return False
 
 
 def native_read_slab(path: str, begin_record: int, num_records: int,
@@ -73,3 +90,18 @@ def native_write_at(path: str, offset_bytes: int, data: np.ndarray) -> None:
                            data.ctypes.data_as(ctypes.c_void_p))
     if put != data.nbytes:
         raise IOError(f"native write of {path} returned {put} != {data.nbytes}")
+
+
+def native_partition(in_path: str, num_parts: int, out_prefix: str,
+                     bits_per_dim: int = 7) -> np.ndarray:
+    """Streaming Morton-order split of a .float3 file into ``num_parts``
+    spatially-coherent ``<out_prefix>_%06d.float3`` files (3 sequential
+    passes, O(8^bits) memory — any input size). Returns per-part counts."""
+    lib = _load()
+    counts = np.zeros(num_parts, np.int64)
+    total = lib.lsk_partition(in_path.encode(), num_parts,
+                              out_prefix.encode(), bits_per_dim,
+                              counts.ctypes.data_as(ctypes.c_void_p))
+    if total < 0:
+        raise IOError(f"native partition of {in_path} failed")
+    return counts

@@ -1,0 +1,99 @@
+"""Spatial pre-partitioning of a raw .float3 file.
+
+The reference's prepartitioned variant requires one spatially-coherent file
+per rank but ships no tool to produce them (README.md:17-23 just assumes
+them). ``partition_float3_file`` is that tool: Morton (Z-order) bucketing
+with equal-count cuts, matching the partitioning the reference's use case
+implies. Native streaming C++ path (io/native_io.cpp, out-of-core, any input
+size) with a numpy fallback implementing the identical rule (same float32
+quantization, same cut positions), so the two paths produce byte-identical
+outputs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _expand_bits21(v: np.ndarray) -> np.ndarray:
+    v = v & np.uint64(0x1FFFFF)
+    v = (v | v << np.uint64(32)) & np.uint64(0x1F00000000FFFF)
+    v = (v | v << np.uint64(16)) & np.uint64(0x1F0000FF0000FF)
+    v = (v | v << np.uint64(8)) & np.uint64(0x100F00F00F00F00F)
+    v = (v | v << np.uint64(4)) & np.uint64(0x10C30C30C30C30C3)
+    v = (v | v << np.uint64(2)) & np.uint64(0x1249249249249249)
+    return v
+
+
+def morton_codes(pts: np.ndarray, lo: np.ndarray, inv_ext: np.ndarray,
+                 bits: int) -> np.ndarray:
+    """Quantized 3-D Morton codes — bit-identical to the C++ ``morton3``:
+    float32 ``(p - lo) * inv_ext``, float64 scaling by ``2^bits - 1``,
+    truncation, clamp."""
+    max_q = np.uint64((1 << bits) - 1)
+    t = (pts.astype(np.float32) - lo.astype(np.float32)) \
+        * inv_ext.astype(np.float32)                    # float32, like C++
+    q = (t.astype(np.float64) * np.float64(max_q)).astype(np.uint64)
+    q = np.minimum(q, max_q)
+    return (_expand_bits21(q[:, 0]) << np.uint64(2)) \
+        | (_expand_bits21(q[:, 1]) << np.uint64(1)) | _expand_bits21(q[:, 2])
+
+
+def partition_float3_file_np(in_path: str, num_parts: int, out_prefix: str,
+                             bits_per_dim: int = 7) -> np.ndarray:
+    """Numpy twin of the native partitioner (in-memory; small files/tests)."""
+    pts = np.fromfile(in_path, np.float32).reshape(-1, 3)
+    n = len(pts)
+    lo = pts.min(axis=0)
+    ext = pts.max(axis=0) - lo                           # float32
+    inv_ext = np.where(ext > 0, np.float32(1.0) / np.where(ext > 0, ext, 1),
+                       np.float32(0.0)).astype(np.float32)
+    codes = morton_codes(pts, lo, inv_ext, bits_per_dim)
+
+    bins = 1 << (3 * bits_per_dim)
+    prefix = np.cumsum(np.bincount(codes.astype(np.int64), minlength=bins))
+    # cut[r] = (first bin whose inclusive prefix >= floor(n*r/parts)) + 1,
+    # exactly the C++ while-loop
+    cut = np.full(num_parts + 1, bins, np.int64)
+    cut[0] = 0
+    for r in range(1, num_parts):
+        cut[r] = np.searchsorted(prefix, n * r // num_parts, side="left") + 1
+    cut = np.maximum.accumulate(cut)
+    part_of = np.searchsorted(cut[1:], codes, side="right")
+
+    counts = np.zeros(num_parts, np.int64)
+    for pr in range(num_parts):
+        sel = pts[part_of == pr]
+        sel.tofile(f"{out_prefix}_{pr:06d}.float3")
+        counts[pr] = len(sel)
+    return counts
+
+
+def partition_float3_file(in_path: str, num_parts: int, out_prefix: str,
+                          bits_per_dim: int = 7,
+                          write_file_list: bool = True) -> np.ndarray:
+    """Split ``in_path`` into ``num_parts`` spatially-coherent float3 files.
+
+    Uses the native streaming path when the toolchain is available, numpy
+    otherwise — but a native run that FAILS raises (falling back to the
+    load-everything numpy path would mask the error and blow memory at
+    exactly the out-of-core scale the native path exists for). Optionally
+    writes ``<out_prefix>.txt`` listing the part files (the prepartitioned
+    CLI's input format). Returns per-part counts.
+    """
+    if not 1 <= bits_per_dim <= 10:
+        raise ValueError(f"bits_per_dim must be in [1, 10], got {bits_per_dim}")
+    if num_parts < 1:
+        raise ValueError(f"num_parts must be >= 1, got {num_parts}")
+    from mpi_cuda_largescaleknn_tpu.io import native
+    if native.available():
+        counts = native.native_partition(in_path, num_parts, out_prefix,
+                                         bits_per_dim)
+    else:
+        counts = partition_float3_file_np(in_path, num_parts, out_prefix,
+                                          bits_per_dim)
+    if write_file_list:
+        with open(f"{out_prefix}.txt", "w") as f:
+            for r in range(num_parts):
+                f.write(f"{out_prefix}_{r:06d}.float3\n")
+    return np.asarray(counts)

@@ -16,7 +16,10 @@ import numpy as np
 from mpi_cuda_largescaleknn_tpu.core.config import KnnConfig
 from mpi_cuda_largescaleknn_tpu.models.sharding import pad_and_flatten, trim_per_shard
 from mpi_cuda_largescaleknn_tpu.obs.timers import PhaseTimers
-from mpi_cuda_largescaleknn_tpu.parallel.demand import demand_knn
+from mpi_cuda_largescaleknn_tpu.parallel.demand import (
+    demand_knn,
+    demand_knn_stepwise,
+)
 from mpi_cuda_largescaleknn_tpu.parallel.mesh import AXIS, get_mesh
 
 
@@ -25,11 +28,6 @@ class PrePartitionedKNN:
 
     def __init__(self, config: KnnConfig, mesh=None):
         config.validate()
-        if config.checkpoint_dir:
-            raise ValueError(
-                "checkpoint/resume is currently supported for the unordered "
-                "(ring) pipeline only; the demand engine's early-exit loop "
-                "is fused on-device and has no between-round host hook")
         self.config = config
         self.mesh = mesh if mesh is not None else get_mesh(
             config.num_shards if config.num_shards > 0 else None)
@@ -60,11 +58,16 @@ class PrePartitionedKNN:
                 partitions, id_bases=list(sizes[:-1]))
 
         with self.timers.phase("demand_ring"):
-            dists, cands, stats = demand_knn(
+            run_fn = (demand_knn_stepwise if cfg.checkpoint_dir
+                      else demand_knn)
+            kwargs = ({"checkpoint_dir": cfg.checkpoint_dir,
+                       "checkpoint_every": cfg.checkpoint_every}
+                      if cfg.checkpoint_dir else {})
+            dists, cands, stats = run_fn(
                 flat, ids, cfg.k, self.mesh, max_radius=cfg.max_radius,
                 engine=cfg.engine, query_tile=cfg.query_tile,
                 point_tile=cfg.point_tile, bucket_size=cfg.bucket_size,
-                return_stats=True)
+                return_stats=True, **kwargs)
             dists = np.asarray(dists)
             self.last_stats = {
                 "rounds": int(np.asarray(stats["rounds"])[0]),

@@ -19,9 +19,8 @@ overlaps with compute. What actually costs time is the *query kernel*. So:
   predicate as ``computeMyPeer`` (box-distance >= cutoff, :168), evaluated
   against ``all_gather``-ed bounds (the reference Allgathers bounds the same
   way, :290-291);
-- the whole loop is a ``lax.while_loop`` whose continue flag is a ``pmax``
-  over "does any device still need any unseen shard" — the global early exit
-  (:320-322) without a host round-trip;
+- the loop ends when a ``pmax`` over "does any device still need any unseen
+  shard" goes to zero — the global early exit (:320-322);
 - the per-query worst-radius reduction that the reference maintains with a
   managed-memory float + ``cukd::atomicMax`` (:91-94, :297-298) is a masked
   ``jnp.max`` over the candidate state each round.
@@ -34,12 +33,17 @@ unneeded shards and keeps every transfer on neighbor ICI links instead of
 arbitrary point-to-point routes. For the reference's own early-exit-friendly
 regime (spatially pre-partitioned files, README.md:17-23) both stop after
 max-over-ranks(#needed-peers) rounds.
+
+Like the ring, the fused on-device loop (``demand_knn``) and the host-stepped
+checkpointable driver (``demand_knn_stepwise``) share one set of builders
+(``_make_demand_fns``).
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from mpi_cuda_largescaleknn_tpu.core.types import (
@@ -55,10 +59,10 @@ from mpi_cuda_largescaleknn_tpu.ops.candidates import (
     init_candidates,
 )
 from mpi_cuda_largescaleknn_tpu.ops.partition import (
+    BucketedPoints,
     partition_points,
     scatter_back,
 )
-from mpi_cuda_largescaleknn_tpu.ops.tiled import knn_update_tiled
 from mpi_cuda_largescaleknn_tpu.parallel.mesh import AXIS, pvary
 from mpi_cuda_largescaleknn_tpu.parallel.ring import (
     _engine_fn,
@@ -66,49 +70,48 @@ from mpi_cuda_largescaleknn_tpu.parallel.ring import (
 )
 
 
-def demand_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
-               mesh, *, max_radius: float = jnp.inf,
-               engine: str = "auto", query_tile: int = 2048,
-               point_tile: int = 2048, bucket_size: int = 512,
-               return_stats: bool = False):
-    """Bounds-pruned kNN over pre-partitioned shards on a 1-D mesh.
+def _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
+                     bucket_size, num_shards):
+    """(init_fn, round_fn, final_fn) shared by the fused and stepwise
+    demand drivers.
 
-    Same data contract as ring_knn (shard-major padded rows); additionally
-    returns, when ``return_stats``, the number of rounds executed and the
-    per-device count of query kernels actually run — the observability the
-    reference only exposes as per-round stdout prints (:306).
+    - init_fn(pts_local, ids_local) -> (ctx, shard_state, heap)
+      ctx = (stationary queries, replicated box distances, arrival schedule,
+      heap validity) — everything the loop reads but never writes.
+    - round_fn(ctx, shard_state, heap, rnd, nrun)
+        -> (next_shard, new_heap, rnd+1, nrun', keep_going)
+      keep_going is replicated (pmax) — usable as a while_loop predicate on
+      device or read on the host by the stepwise driver.
+    - final_fn(ctx, heap) -> (dists, hd2, hidx) in input-row order.
     """
-    num_shards = mesh.shape[AXIS]
     use_tiled = engine in ("tiled", "auto", "pallas_tiled")
     update = None if use_tiled else _engine_fn(engine, query_tile, point_tile)
     tiled_update = _tiled_engine_fn(engine) if use_tiled else None
     use_tree = engine == "tree"
     fwd = [(i, (i + 1) % num_shards) for i in range(num_shards)]
 
-    def body(pts_local, ids_local):
+    def init_fn(pts_local, ids_local):
         me = jax.lax.axis_index(AXIS)
-        npad = pts_local.shape[0]
         valid = pts_local[:, 0] < PAD_SENTINEL / 2
         if use_tiled:
             # bucketed structures: queries and the rotating shard both carry
-            # per-bucket bounds; the tile-level prune inside knn_update_tiled
+            # per-bucket bounds; the tile-level prune inside the tiled update
             # subsumes most of the shard-level skip, which remains as a
             # cheap outer gate
             q = partition_points(pts_local, ids_local,
                                  bucket_size=bucket_size)
-            queries = None
             shard_state = (q.pts, q.ids, q.lower, q.upper)
             heap_rows = q.num_buckets * q.bucket_size
             heap_valid = (q.ids >= 0).reshape(-1)
+            stationary = q
         elif use_tree:
-            queries = pts_local
-            shard, shard_ids = build_tree(pts_local, ids_local)
-            shard_state = (shard, shard_ids)
-            heap_rows, heap_valid = npad, valid
+            shard_state = build_tree(pts_local, ids_local)
+            heap_rows, heap_valid = pts_local.shape[0], valid
+            stationary = pts_local
         else:
-            queries = pts_local
             shard_state = (pts_local, ids_local)
-            heap_rows, heap_valid = npad, valid
+            heap_rows, heap_valid = pts_local.shape[0], valid
+            stationary = pts_local
 
         # bounds of every shard's real points, replicated to all devices
         # (the reference's Allgather of 6-float boxes, :290-291)
@@ -122,61 +125,108 @@ def demand_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
         arrival_round = jnp.mod(me - jnp.arange(num_shards), num_shards)
 
         heap = pvary(init_candidates(heap_rows, k, max_radius))
+        ctx = (stationary, box_dist, arrival_round, heap_valid)
+        return ctx, shard_state, heap
+
+    def round_fn(ctx, shard_state, heap, rnd, nrun):
+        stationary, box_dist, arrival_round, heap_valid = ctx
+        me = jax.lax.axis_index(AXIS)
+        nxt = jax.tree.map(lambda a: jax.lax.ppermute(a, AXIS, fwd),
+                           shard_state)
+
+        cur_radius = current_worst_radius(heap, heap_valid)
+        src = jnp.mod(me - rnd, num_shards)
+        # visit iff the resident shard's box is strictly closer than the
+        # current worst k-th distance (computeMyPeer's prune, :168);
+        # round 0 is the own shard at distance 0
+        do_visit = jax.lax.dynamic_index_in_dim(
+            box_dist, src, keepdims=False) < cur_radius
+
+        def run(_):
+            if use_tiled:
+                resident = BucketedPoints(
+                    shard_state[0], shard_state[1], shard_state[2],
+                    shard_state[3], shard_state[1])
+                st = tiled_update(heap, stationary, resident)
+            else:
+                st = update(heap, stationary, *shard_state)
+            return st.dist2, st.idx
+
+        hd2, hidx = jax.lax.cond(do_visit, run,
+                                 lambda _: (heap.dist2, heap.idx), None)
+        new_heap = CandidateState(hd2, hidx)
+        nrun = nrun + do_visit.astype(jnp.int32)
+
+        # global early exit: does ANY device still need ANY unseen shard?
+        new_radius = current_worst_radius(new_heap, heap_valid)
+        i_need_more = jnp.any((arrival_round > rnd) & (box_dist < new_radius))
+        keep_going = jax.lax.pmax(i_need_more.astype(jnp.int32), AXIS) > 0
+        return nxt, new_heap, rnd + 1, nrun, keep_going
+
+    def final_fn(ctx, heap):
+        stationary, _box, _arr, _hv = ctx
+        dists = extract_final_result(heap)
+        if use_tiled:
+            q = stationary
+            # scatter back to input-row order over B*S rows (an upper bound
+            # on the padded slab size — input rows live in [0, npad), the
+            # drivers trim with _trim_rows)
+            rows = q.pos.shape[0] * q.pos.shape[1]
+            kk = heap.dist2.shape[-1]
+            bs = (q.num_buckets, q.bucket_size)
+            dists = scatter_back(dists.reshape(bs), q.pos, rows,
+                                 fill=jnp.inf)
+            hd2 = scatter_back(heap.dist2.reshape(bs + (kk,)), q.pos, rows,
+                               fill=jnp.inf)
+            hidx = scatter_back(heap.idx.reshape(bs + (kk,)), q.pos, rows,
+                                fill=-1)
+            return dists, hd2, hidx
+        return dists, heap.dist2, heap.idx
+
+    return init_fn, round_fn, final_fn
+
+
+def demand_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
+               mesh, *, max_radius: float = jnp.inf,
+               engine: str = "auto", query_tile: int = 2048,
+               point_tile: int = 2048, bucket_size: int = 512,
+               return_stats: bool = False):
+    """Bounds-pruned kNN over pre-partitioned shards on a 1-D mesh (fused
+    on-device ``lax.while_loop``).
+
+    Same data contract as ring_knn (shard-major padded rows); additionally
+    returns, when ``return_stats``, the number of rounds executed and the
+    per-device count of query kernels actually run — the observability the
+    reference only exposes as per-round stdout prints (:306).
+    """
+    num_shards = mesh.shape[AXIS]
+    npad = points_sharded.shape[0] // num_shards
+    init_fn, round_fn, final_fn = _make_demand_fns(
+        k, max_radius, engine, query_tile, point_tile, bucket_size,
+        num_shards)
+
+    def body(pts_local, ids_local):
+        ctx, shard_state, heap = init_fn(pts_local, ids_local)
 
         def cond(carry):
-            _shard, _hd2, _hidx, rnd, keep_going, _nrun = carry
+            _s, _h2, _hi, rnd, keep_going, _n = carry
             return (rnd < num_shards) & keep_going
 
-        def round_body(carry):
+        def loop_body(carry):
             shard_state, hd2, hidx, rnd, _kg, nrun = carry
-            nxt = jax.tree.map(lambda a: jax.lax.ppermute(a, AXIS, fwd),
-                               shard_state)
-
-            cur_radius = current_worst_radius(CandidateState(hd2, hidx),
-                                              heap_valid)
-            src = jnp.mod(me - rnd, num_shards)
-            # visit iff the resident shard's box is strictly closer than the
-            # current worst k-th distance (computeMyPeer's prune, :168);
-            # round 0 is the own shard at distance 0
-            do_visit = jax.lax.dynamic_index_in_dim(
-                box_dist, src, keepdims=False) < cur_radius
-
-            def run(_):
-                if use_tiled:
-                    resident = q._replace(
-                        pts=shard_state[0], ids=shard_state[1],
-                        lower=shard_state[2], upper=shard_state[3])
-                    st = tiled_update(CandidateState(hd2, hidx), q,
-                                      resident)
-                else:
-                    st = update(CandidateState(hd2, hidx), queries,
-                                *shard_state)
-                return st.dist2, st.idx
-
-            hd2, hidx = jax.lax.cond(do_visit, run, lambda _: (hd2, hidx), None)
-            nrun = nrun + do_visit.astype(jnp.int32)
-
-            # global early exit: does ANY device still need ANY unseen shard?
-            new_radius = current_worst_radius(CandidateState(hd2, hidx),
-                                              heap_valid)
-            i_need_more = jnp.any((arrival_round > rnd) & (box_dist < new_radius))
-            keep_going = jax.lax.pmax(i_need_more.astype(jnp.int32), AXIS) > 0
-            return nxt, hd2, hidx, rnd + 1, keep_going, nrun
+            nxt, heap2, rnd2, nrun2, keep_going = round_fn(
+                ctx, shard_state, CandidateState(hd2, hidx), rnd, nrun)
+            return nxt, heap2.dist2, heap2.idx, rnd2, keep_going, nrun2
 
         # rnd and keep_going are uniform across devices (keep_going is a pmax
         # reduction, hence replicated); nrun is per-device
         init = (shard_state, heap.dist2, heap.idx,
                 jnp.int32(0), jnp.bool_(True), pvary(jnp.int32(0)))
-        _, hd2, hidx, rounds, _, nrun = jax.lax.while_loop(cond, round_body, init)
-        heap = CandidateState(hd2, hidx)
-        dists = extract_final_result(heap)
-        if use_tiled:
-            bs = (q.num_buckets, q.bucket_size)
-            dists = scatter_back(dists.reshape(bs), q.pos, npad, fill=jnp.inf)
-            hd2 = scatter_back(hd2.reshape(bs + (k,)), q.pos, npad,
-                               fill=jnp.inf)
-            hidx = scatter_back(hidx.reshape(bs + (k,)), q.pos, npad, fill=-1)
-        return dists, hd2, hidx, pvary(rounds)[None], nrun[None]
+        _, hd2, hidx, rounds, _, nrun = jax.lax.while_loop(
+            cond, loop_body, init)
+        d, hd2, hidx = final_fn(ctx, CandidateState(hd2, hidx))
+        d, hd2, hidx = _trim_rows(ctx, d, hd2, hidx, npad)
+        return d, hd2, hidx, pvary(rounds)[None], nrun[None]
 
     spec = P(AXIS)
     # see ring.py: pallas engines need check_vma=False under shard_map
@@ -193,3 +243,103 @@ def demand_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
         return dists, CandidateState(hd2, hidx), {
             "rounds": rounds, "kernels_run": nrun}
     return dists
+
+
+def _trim_rows(ctx, d, hd2, hidx, npad):
+    """Cut the tiled path's scatter target (B*S rows) down to the caller's
+    padded slab size; flat paths are already npad rows."""
+    return d[:npad], hd2[:npad], hidx[:npad]
+
+
+def demand_knn_stepwise(points_sharded: jnp.ndarray,
+                        ids_sharded: jnp.ndarray, k: int, mesh, *,
+                        max_radius: float = jnp.inf, engine: str = "auto",
+                        query_tile: int = 2048, point_tile: int = 2048,
+                        bucket_size: int = 512,
+                        checkpoint_dir: str | None = None,
+                        checkpoint_every: int = 1,
+                        max_rounds: int | None = None,
+                        return_stats: bool = False):
+    """``demand_knn`` with host-controlled rounds + checkpoint/resume.
+
+    Same builders as the fused driver; the early-exit predicate (a replicated
+    pmax) is returned from each jitted step and read on the host, so the
+    adaptive round count survives intact. Checkpoint state = (round, rotating
+    shard, heaps, per-device kernel counts); the prelude (bounds gather,
+    arrival schedule, bucketing) is recomputed deterministically on resume.
+    """
+    from mpi_cuda_largescaleknn_tpu.utils import checkpoint as ckpt
+
+    num_shards = mesh.shape[AXIS]
+    npad = points_sharded.shape[0] // num_shards
+    init_fn, round_fn, final_fn = _make_demand_fns(
+        k, max_radius, engine, query_tile, point_tile, bucket_size,
+        num_shards)
+    spec = P(AXIS)
+    check_vma = not engine.startswith("pallas")
+    sharding = NamedSharding(mesh, spec)
+
+    def smap(fn, n_in, out_specs):
+        return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=(spec,) * n_in,
+                                     out_specs=out_specs,
+                                     check_vma=check_vma))
+
+    pts = jax.device_put(np.asarray(points_sharded, np.float32), sharding)
+    ids = jax.device_put(np.asarray(ids_sharded, np.int32), sharding)
+
+    ctx, shard_state, heap = smap(init_fn, 2, (spec, spec, spec))(pts, ids)
+    nrun = jax.device_put(np.zeros(num_shards, np.int32), sharding)
+
+    def step_fn(ctx, shard_state, heap, rnd_arr, nrun):
+        # rnd rides as a per-device [1] array so every input is sharded;
+        # keep_going comes back the same way (replicated by construction)
+        nxt, heap2, rnd2, nrun2, keep_going = round_fn(
+            ctx, shard_state, heap, rnd_arr[0], nrun[0])
+        return (nxt, heap2, rnd2[None], nrun2[None],
+                keep_going.astype(jnp.int32)[None])
+
+    step = smap(step_fn, 5, (spec, spec, spec, spec, spec))
+
+    fp = None
+    start = 0
+    if checkpoint_dir:
+        fp = ckpt.fingerprint(
+            n=int(pts.shape[0]), k=int(k), shards=num_shards, engine=engine,
+            max_radius=float(max_radius), bucket_size=bucket_size,
+            kind="demand", data=ckpt.data_digest(points_sharded, ids_sharded))
+        got = ckpt.load_pytree(checkpoint_dir, fp,
+                               (shard_state, heap, nrun), sharding)
+        if got is not None:
+            start, (shard_state, heap, nrun) = got
+
+    rnd_arr = jax.device_put(
+        np.full(num_shards, start, np.int32), sharding)
+    rounds_done = start
+    stop = num_shards if max_rounds is None else min(max_rounds, num_shards)
+    finished = start >= stop
+    while not finished:
+        shard_state, heap, rnd_arr, nrun, kg = step(
+            ctx, shard_state, heap, rnd_arr, nrun)
+        rounds_done += 1
+        keep_going = bool(np.asarray(kg)[0])
+        finished = (not keep_going) or rounds_done >= stop
+        if checkpoint_dir and (rounds_done % checkpoint_every == 0
+                               or finished):
+            ckpt.save_pytree(checkpoint_dir, rounds_done,
+                             (shard_state, heap, nrun), fp)
+        if not keep_going:
+            break
+
+    d, hd2, hidx = smap(
+        lambda c, h: _trim_rows(c, *final_fn(c, h), npad), 2,
+        (spec, spec, spec))(ctx, heap)
+    # completed runs clear their checkpoint (stale-state safety); runs
+    # truncated by max_rounds keep it so a relaunch resumes
+    if checkpoint_dir and max_rounds is None:
+        ckpt.clear(checkpoint_dir)
+    if return_stats:
+        return (np.asarray(d), CandidateState(np.asarray(hd2),
+                                              np.asarray(hidx)),
+                {"rounds": np.full(num_shards, rounds_done),
+                 "kernels_run": np.asarray(nrun)})
+    return np.asarray(d)

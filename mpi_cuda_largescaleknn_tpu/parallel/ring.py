@@ -300,23 +300,16 @@ def ring_knn_stepwise(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
 
     start = 0
     if checkpoint_dir:
-        got = ckpt.load_ring_state(checkpoint_dir, fp)
+        got = ckpt.load_pytree(checkpoint_dir, fp, (shard, heap), sharding)
         if got is not None:
-            start, arrs = got
-            flat, treedef = jax.tree.flatten((shard, heap))
-            restored = [jax.device_put(arrs[f"a{i}"], sharding)
-                        for i in range(len(flat))]
-            shard, heap = jax.tree.unflatten(treedef, restored)
+            start, (shard, heap) = got
 
     stop = num_shards if max_rounds is None else min(max_rounds, num_shards)
     for r in range(start, stop):
         shard, heap = step(stationary, shard, heap)
         if checkpoint_dir and ((r + 1) % checkpoint_every == 0
                                or r + 1 == stop):
-            flat, _ = jax.tree.flatten((shard, heap))
-            jax.block_until_ready(flat)
-            ckpt.save_ring_state(checkpoint_dir, r + 1,
-                                 {f"a{i}": a for i, a in enumerate(flat)}, fp)
+            ckpt.save_pytree(checkpoint_dir, r + 1, (shard, heap), fp)
 
     dists, hd2, hidx = smap(
         lambda s, h: final_fn(s, h, npad_local), 2,
@@ -415,8 +408,9 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
             if return_candidates:
                 out_hd2, out_idx = arrs["out_hd2"], arrs["out_idx"]
 
+    # absolute cap, consistent with the stepwise drivers' max_rounds
     stop_chunk = (n_chunks if max_chunks is None
-                  else min(start_chunk + max_chunks, n_chunks))
+                  else min(max_chunks, n_chunks))
     for c in range(start_chunk, stop_chunk):
         lo = c * chunk_rows
         hi = min(lo + chunk_rows, npad_local)

@@ -94,3 +94,31 @@ def clear(ckpt_dir: str) -> None:
     p = os.path.join(ckpt_dir, _STATE)
     if os.path.exists(p):
         os.remove(p)
+
+
+def save_pytree(ckpt_dir: str, round_idx: int, tree, manifest: dict) -> None:
+    """Snapshot an arbitrary pytree of arrays (leaves keyed positionally).
+
+    Shared by the stepwise ring and demand drivers so the snapshot format
+    cannot drift between them."""
+    import jax
+
+    flat, _ = jax.tree.flatten(tree)
+    jax.block_until_ready(flat)
+    save_ring_state(ckpt_dir, round_idx,
+                    {f"a{i}": a for i, a in enumerate(flat)}, manifest)
+
+
+def load_pytree(ckpt_dir: str, manifest: dict, like, sharding):
+    """Restore a pytree saved by ``save_pytree``; ``like`` supplies the
+    treedef, ``sharding`` the placement. Returns (round_idx, tree) or None."""
+    import jax
+
+    got = load_ring_state(ckpt_dir, manifest)
+    if got is None:
+        return None
+    round_idx, arrs = got
+    flat, treedef = jax.tree.flatten(like)
+    restored = [jax.device_put(arrs[f"a{i}"], sharding)
+                for i in range(len(flat))]
+    return round_idx, jax.tree.unflatten(treedef, restored)

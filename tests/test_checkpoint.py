@@ -99,14 +99,54 @@ def test_checkpoint_data_change_raises(tmp_path):
                           checkpoint_dir=cdir)
 
 
-def test_prepartitioned_checkpoint_rejected():
+def test_demand_stepwise_matches_fused():
+    from mpi_cuda_largescaleknn_tpu.parallel.demand import (
+        demand_knn,
+        demand_knn_stepwise,
+    )
+
+    pts = random_points(640, seed=21)
+    pts = pts[np.argsort(pts[:, 0], kind="stable")]
+    mesh = get_mesh(8)
+    flat, ids, _, _ = _sharded(pts, 8)
+    fused, _c, fstats = demand_knn(flat, ids, 5, mesh, bucket_size=16,
+                                   return_stats=True)
+    step, _c2, sstats = demand_knn_stepwise(flat, ids, 5, mesh,
+                                            bucket_size=16,
+                                            return_stats=True)
+    np.testing.assert_array_equal(np.asarray(fused), step)
+    # the adaptive early exit survives the host-stepped loop
+    assert int(sstats["rounds"][0]) == int(np.asarray(fstats["rounds"])[0])
+
+
+def test_demand_stepwise_resume(tmp_path):
+    from mpi_cuda_largescaleknn_tpu.parallel.demand import demand_knn_stepwise
+
+    pts = random_points(480, seed=23)
+    pts = pts[np.argsort(pts[:, 0], kind="stable")]
+    mesh = get_mesh(8)
+    flat, ids, _, _ = _sharded(pts, 8)
+    cdir = str(tmp_path / "dk")
+    want = demand_knn_stepwise(flat, ids, 5, mesh, bucket_size=16)
+    partial = demand_knn_stepwise(flat, ids, 5, mesh, bucket_size=16,
+                                  checkpoint_dir=cdir, max_rounds=2)
+    del partial
+    resumed = demand_knn_stepwise(flat, ids, 5, mesh, bucket_size=16,
+                                  checkpoint_dir=cdir)
+    np.testing.assert_array_equal(resumed, want)
+
+
+def test_prepartitioned_model_checkpointed_oracle(tmp_path):
     from mpi_cuda_largescaleknn_tpu.models.prepartitioned import (
         PrePartitionedKNN,
     )
 
-    with pytest.raises(ValueError, match="unordered"):
-        PrePartitionedKNN(KnnConfig(k=3, checkpoint_dir="/tmp/x"),
-                          mesh=get_mesh(8))
+    pts = random_points(400, seed=25)
+    pts = pts[np.argsort(pts[:, 0], kind="stable")]
+    parts = [pts[i * 50:(i + 1) * 50] for i in range(8)]
+    cfg = KnnConfig(k=4, bucket_size=16, checkpoint_dir=str(tmp_path / "p"))
+    got = np.concatenate(PrePartitionedKNN(cfg, mesh=get_mesh(8)).run(parts))
+    assert_dist_equal(got, kth_nn_dist(pts, pts, 4))
 
 
 def test_model_level_checkpoint_and_oracle(tmp_path):

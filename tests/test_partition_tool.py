@@ -1,0 +1,85 @@
+"""Spatial pre-partitioner tool (io/partition_file.py + native C++ path)."""
+
+import numpy as np
+import pytest
+
+from mpi_cuda_largescaleknn_tpu.io.partition_file import (
+    partition_float3_file,
+    partition_float3_file_np,
+)
+from tests.oracle import assert_dist_equal, kth_nn_dist, random_points
+
+
+def _read_parts(prefix, n):
+    return [np.fromfile(f"{prefix}_{r:06d}.float3", np.float32).reshape(-1, 3)
+            for r in range(n)]
+
+
+def test_partition_preserves_points_and_balances(tmp_path):
+    pts = random_points(4000, seed=3)
+    inp = tmp_path / "in.float3"
+    pts.tofile(inp)
+    counts = partition_float3_file(str(inp), 8, str(tmp_path / "p"))
+    assert counts.sum() == 4000
+    # near-equal split: morton bins are fine-grained at 4000 points
+    assert counts.max() - counts.min() <= 0.2 * 4000 / 8 + 64
+    parts = _read_parts(str(tmp_path / "p"), 8)
+    # the union of parts is exactly the input point multiset
+    got = np.concatenate(parts)
+    assert sorted(map(tuple, got.tolist())) == sorted(map(tuple, pts.tolist()))
+    # file list written in prepartitioned_main's format
+    names = (tmp_path / "p.txt").read_text().splitlines()
+    assert len(names) == 8 and names[0].endswith("_000000.float3")
+
+
+def test_native_and_numpy_paths_identical(tmp_path):
+    pts = random_points(3000, seed=5, scale=3.0)
+    inp = tmp_path / "in.float3"
+    pts.tofile(inp)
+    try:
+        from mpi_cuda_largescaleknn_tpu.io.native import native_partition
+        native_partition(str(inp), 4, str(tmp_path / "nat"))
+    except Exception:
+        pytest.skip("native toolchain unavailable")
+    partition_float3_file_np(str(inp), 4, str(tmp_path / "np"))
+    for r in range(4):
+        a = (tmp_path / f"nat_{r:06d}.float3").read_bytes()
+        b = (tmp_path / f"np_{r:06d}.float3").read_bytes()
+        assert a == b, f"part {r} differs between native and numpy"
+
+
+def test_parts_are_spatially_coherent(tmp_path):
+    """Each part's bounding box should be much smaller than the global box —
+    the property the prepartitioned variant's pruning feeds on."""
+    pts = random_points(8000, seed=7)
+    inp = tmp_path / "in.float3"
+    pts.tofile(inp)
+    partition_float3_file(str(inp), 8, str(tmp_path / "p"))
+    parts = _read_parts(str(tmp_path / "p"), 8)
+    global_vol = np.prod(pts.max(0) - pts.min(0))
+    vols = [np.prod(p.max(0) - p.min(0)) for p in parts if len(p)]
+    # Z-order ranges are unions of octree cells; allow generous slack but
+    # still far below "every part spans everything"
+    assert np.median(vols) < 0.5 * global_vol
+
+
+def test_end_to_end_partition_then_knn(tmp_path):
+    """partition_main -> prepartitioned_main: full tool-chain parity run."""
+    from mpi_cuda_largescaleknn_tpu.cli import partition_main
+    from mpi_cuda_largescaleknn_tpu.cli.prepartitioned_main import (
+        main as prepart_main,
+    )
+
+    pts = random_points(640, seed=9)
+    inp = tmp_path / "in.float3"
+    pts.tofile(inp)
+    partition_main.main([str(inp), "-n", "8", "-o", str(tmp_path / "p")])
+    prepart_main([str(tmp_path / "p.txt"), "-k", "5",
+                  "-o", str(tmp_path / "d"), "--bucket-size", "16"])
+    parts = _read_parts(str(tmp_path / "p"), 8)
+    got = np.concatenate([
+        np.fromfile(tmp_path / f"d_{r:06d}.float", np.float32)
+        for r in range(8)])
+    # outputs are in part order; oracle over the same ordering
+    allp = np.concatenate(parts)
+    assert_dist_equal(got, kth_nn_dist(allp, allp, 5))
