@@ -1,0 +1,160 @@
+"""BASELINE benchmark suite — instruments the configs of BASELINE.json.
+
+The reference publishes no numbers (BASELINE.md), so this suite ESTABLISHES
+the baseline: per config it reports kNN queries/sec and the cross-shard
+exchange bandwidth derived from the phase timers (obs/timers.py).
+
+Each config runs in its own subprocess with its own device environment:
+single-chip configs use the real TPU when reachable; multi-shard configs use
+the virtual-device CPU mesh (this container exposes ONE real chip — the
+multi-chip path is validated for correctness/compilation there and measured
+for real on a pod). Sizes scale down automatically off-TPU; results are
+labeled with platform + actual size so nothing is presented as something it
+is not.
+
+    python benchmarks.py            # quick sizes
+    python benchmarks.py --full     # BASELINE.json sizes where feasible
+
+Writes benchmarks_report.json and prints one JSON line per config.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+_CHILD = r"""
+import json, os, sys, time
+import numpy as np
+
+spec = json.loads(sys.argv[1])
+
+import jax  # noqa: E402  (after env is set by parent)
+
+from mpi_cuda_largescaleknn_tpu.core.config import KnnConfig
+from mpi_cuda_largescaleknn_tpu.models.prepartitioned import PrePartitionedKNN
+from mpi_cuda_largescaleknn_tpu.models.unordered import UnorderedKNN
+from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+
+n, k, shards = spec["n"], spec["k"], spec["shards"]
+rng = np.random.default_rng(11)
+pts = rng.random((n, 3)).astype(np.float32)
+cfg = KnnConfig(k=k, engine=spec.get("engine", "auto"),
+                query_chunk=spec.get("query_chunk", 0),
+                bucket_size=spec.get("bucket_size", 512))
+mesh = get_mesh(shards)
+
+if spec["pipeline"] == "unordered":
+    model = UnorderedKNN(cfg, mesh=mesh)
+    model.run(pts)                        # compile warmup
+    model.timers.phases.clear()
+    t0 = time.perf_counter()
+    out = model.run(pts)
+    dt = time.perf_counter() - t0
+    assert out.shape == (n,)
+else:
+    pts = pts[np.argsort(pts[:, 0], kind="stable")]
+    bounds = [(n * r // shards, n * (r + 1) // shards) for r in range(shards)]
+    parts = [pts[b:e] for b, e in bounds]
+    model = PrePartitionedKNN(cfg, mesh=mesh)
+    model.run(parts)
+    model.timers.phases.clear()
+    t0 = time.perf_counter()
+    outs = model.run(parts)
+    dt = time.perf_counter() - t0
+    assert sum(len(o) for o in outs) == n
+
+rep = model.timers.report()
+ring = rep.get("ring") or rep.get("demand_ring") or {}
+print("RESULT " + json.dumps({
+    "config": spec["name"],
+    "pipeline": spec["pipeline"],
+    "n_points": n, "k": k, "shards": shards,
+    "scaled_down": spec.get("scaled", False),
+    "platform": jax.devices()[0].platform,
+    "queries_per_sec": round(n / dt, 1),
+    "seconds": round(dt, 3),
+    "exchange_GB_per_sec": ring.get("GB/s", 0.0),
+    "stats": getattr(model, "last_stats", None),
+}), flush=True)
+"""
+
+
+def _tpu_ok(timeout_s: float = 75.0) -> bool:
+    probe = ("import jax; d=jax.devices(); "
+             "import sys; sys.exit(0 if d and d[0].platform != 'cpu' else 1)")
+    try:
+        return subprocess.run([sys.executable, "-c", probe],
+                              timeout=timeout_s,
+                              capture_output=True).returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main() -> int:
+    full = "--full" in sys.argv
+    tpu = _tpu_ok()
+
+    def env_for(shards: int, use_tpu: bool):
+        env = dict(os.environ)
+        if not use_tpu:
+            env["JAX_PLATFORMS"] = "cpu"
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            flags = env.get("XLA_FLAGS", "")
+            env["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={shards}"
+            ).strip()
+        return env
+
+    # (name, pipeline, (shards, n, k) full, (shards, n, k) quick, extras)
+    # quick mode shrinks n/k/shards so the CPU smoke run finishes in minutes
+    # (k dominates: the merge works on width-2k rows); results carry the
+    # actual parameters so scaled runs cannot masquerade as spec runs
+    configs = [
+        ("unordered_1dev_k8", "unordered",
+         (1, 1_000_000, 8), (1, 200_000 if tpu else 20_000, 8), {}),
+        ("unordered_8shard_k100", "unordered",
+         (8, 400_000, 100), (8, 16_000, 32), {}),
+        ("prepartitioned_8shard_k100", "prepartitioned",
+         (8, 400_000, 100), (8, 16_000, 32), {}),
+        ("prepartitioned_64shard_k500_overlap", "prepartitioned",
+         (64, 256_000, 500), (16, 16_000, 64), {"bucket_size": 128}),
+        ("unordered_streaming_chunked_k100", "unordered",
+         (8, 400_000, 100), (8, 16_000, 32), {"query_chunk": 1024}),
+    ]
+
+    results = []
+    for name, pipeline, full_snk, quick_snk, extras in configs:
+        shards, n, k = full_snk if full else quick_snk
+        use_tpu = tpu and shards == 1
+        spec = {"name": name, "pipeline": pipeline, "shards": shards,
+                "n": n, "k": k, "scaled": not full, **extras}
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _CHILD, json.dumps(spec)],
+                timeout=float(os.environ.get("BENCHSUITE_TIMEOUT_S", 1200)),
+                capture_output=True, text=True,
+                env=env_for(shards, use_tpu))
+        except subprocess.TimeoutExpired:
+            results.append({"config": name, "error": "timeout"})
+            print(json.dumps(results[-1]), flush=True)
+            continue
+        line = next((ln for ln in r.stdout.splitlines()
+                     if ln.startswith("RESULT ")), None)
+        if r.returncode != 0 or line is None:
+            results.append({"config": name,
+                            "error": (r.stderr or "no output")[-500:]})
+        else:
+            results.append(json.loads(line[len("RESULT "):]))
+        print(json.dumps(results[-1]), flush=True)
+
+    with open("benchmarks_report.json", "w") as f:
+        json.dump({"full": full, "tpu_available": tpu,
+                   "results": results}, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -53,6 +53,8 @@ def main(argv: list[str] | None = None) -> int:
         usage("no output prefix specified")
     if num_parts < 1:
         usage("no part count specified, or invalid -n value")
+    if not 1 <= bits <= 10:
+        usage(f"--bits must be in [1, 10], got {bits}")
 
     counts = partition_float3_file(in_path, num_parts, out_prefix, bits)
     for r, c in enumerate(counts):

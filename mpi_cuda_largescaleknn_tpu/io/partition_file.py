@@ -41,9 +41,21 @@ def morton_codes(pts: np.ndarray, lo: np.ndarray, inv_ext: np.ndarray,
 
 def partition_float3_file_np(in_path: str, num_parts: int, out_prefix: str,
                              bits_per_dim: int = 7) -> np.ndarray:
-    """Numpy twin of the native partitioner (in-memory; small files/tests)."""
+    """Numpy twin of the native partitioner (in-memory; small files/tests).
+
+    Matches the native path's edge behavior too: a file whose size is not a
+    multiple of 12 bytes is rejected (the C++ checks fsize % 12), and an
+    empty input yields empty part files with zero counts."""
+    import os
+
+    if os.path.getsize(in_path) % 12 != 0:
+        raise IOError(f"{in_path} is not a whole number of float3 records")
     pts = np.fromfile(in_path, np.float32).reshape(-1, 3)
     n = len(pts)
+    if n == 0:
+        for pr in range(num_parts):
+            pts.tofile(f"{out_prefix}_{pr:06d}.float3")
+        return np.zeros(num_parts, np.int64)
     lo = pts.min(axis=0)
     ext = pts.max(axis=0) - lo                           # float32
     inv_ext = np.where(ext > 0, np.float32(1.0) / np.where(ext > 0, ext, 1),

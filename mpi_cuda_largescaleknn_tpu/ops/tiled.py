@@ -102,19 +102,29 @@ def knn_update_tiled(state: CandidateState, q: BucketedPoints,
 
         def chunk_fn(args):
             qp, pp, pid, act, cd2, cidx = args
-            dx = qp[:, :, None, 0] - pp[:, None, :, 0]
-            dy = qp[:, :, None, 1] - pp[:, None, :, 1]
-            dz = qp[:, :, None, 2] - pp[:, None, :, 2]
-            d2 = (dx * dx + dy * dy) + dz * dz                      # [C,S,T]
-            d2 = jnp.where(act[:, None, None], d2, jnp.inf)
-            st = merge_candidates(
-                CandidateState(cd2.reshape(chunk * s_q, k),
-                               cidx.reshape(chunk * s_q, k)),
-                d2.reshape(chunk * s_q, s_p),
-                jnp.broadcast_to(pid[:, None, :, ...],
-                                 (chunk, s_q, s_p)).reshape(chunk * s_q, s_p))
-            return (st.dist2.reshape(chunk, s_q, k),
-                    st.idx.reshape(chunk, s_q, k))
+
+            def compute(_):
+                dx = qp[:, :, None, 0] - pp[:, None, :, 0]
+                dy = qp[:, :, None, 1] - pp[:, None, :, 1]
+                dz = qp[:, :, None, 2] - pp[:, None, :, 2]
+                d2 = (dx * dx + dy * dy) + dz * dz                  # [C,S,T]
+                d2 = jnp.where(act[:, None, None], d2, jnp.inf)
+                st = merge_candidates(
+                    CandidateState(cd2.reshape(chunk * s_q, k),
+                                   cidx.reshape(chunk * s_q, k)),
+                    d2.reshape(chunk * s_q, s_p),
+                    jnp.broadcast_to(pid[:, None, :, ...],
+                                     (chunk, s_q, s_p)).reshape(
+                                         chunk * s_q, s_p))
+                return (st.dist2.reshape(chunk, s_q, k),
+                        st.idx.reshape(chunk, s_q, k))
+
+            # chunks whose buckets are ALL pruned this step skip the tile
+            # entirely (lax.map runs chunks sequentially, so the cond branch
+            # is real skipped work, not a select) — recovers most of the
+            # lock-step waste in late rounds when few stragglers remain
+            return lax.cond(jnp.any(act), compute,
+                            lambda _: (cd2, cidx), None)
 
         hd2, hidx = lax.map(chunk_fn, (
             q_chunked,

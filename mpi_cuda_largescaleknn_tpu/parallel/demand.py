@@ -225,7 +225,7 @@ def demand_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
         _, hd2, hidx, rounds, _, nrun = jax.lax.while_loop(
             cond, loop_body, init)
         d, hd2, hidx = final_fn(ctx, CandidateState(hd2, hidx))
-        d, hd2, hidx = _trim_rows(ctx, d, hd2, hidx, npad)
+        d, hd2, hidx = _trim_rows(d, hd2, hidx, npad)
         return d, hd2, hidx, pvary(rounds)[None], nrun[None]
 
     spec = P(AXIS)
@@ -245,7 +245,7 @@ def demand_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
     return dists
 
 
-def _trim_rows(ctx, d, hd2, hidx, npad):
+def _trim_rows(d, hd2, hidx, npad):
     """Cut the tiled path's scatter target (B*S rows) down to the caller's
     padded slab size; flat paths are already npad rows."""
     return d[:npad], hd2[:npad], hidx[:npad]
@@ -323,15 +323,18 @@ def demand_knn_stepwise(points_sharded: jnp.ndarray,
         rounds_done += 1
         keep_going = bool(np.asarray(kg)[0])
         finished = (not keep_going) or rounds_done >= stop
+        # no final save on a naturally-completing run (max_rounds unset):
+        # it would be cleared moments later — pure wasted sync + disk IO
+        want_final_save = finished and max_rounds is not None
         if checkpoint_dir and (rounds_done % checkpoint_every == 0
-                               or finished):
+                               or want_final_save):
             ckpt.save_pytree(checkpoint_dir, rounds_done,
                              (shard_state, heap, nrun), fp)
         if not keep_going:
             break
 
     d, hd2, hidx = smap(
-        lambda c, h: _trim_rows(c, *final_fn(c, h), npad), 2,
+        lambda c, h: _trim_rows(*final_fn(c, h), npad), 2,
         (spec, spec, spec))(ctx, heap)
     # completed runs clear their checkpoint (stale-state safety); runs
     # truncated by max_rounds keep it so a relaunch resumes
