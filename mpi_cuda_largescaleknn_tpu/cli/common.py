@@ -33,6 +33,9 @@ TPU-side options (no reference analogue):
                     pipelines); an interrupted run relaunched with the same
                     args resumes at the lost round
   --checkpoint-every N  rounds between snapshots (default 1)
+  --selfcheck N     after the run, verify N random outputs against an exact
+                    streamed recomputation and fail loudly on mismatch (the
+                    working version of the reference's disabled probe blocks)
   --write-indices P  also write the k neighbor IDs per point (int32, ascending
                     by distance, -1 = fewer than k found): unordered -> one
                     file P in global point order; prepartitioned -> one
@@ -58,7 +61,7 @@ def parse_args(program: str, argv: list[str]):
     extras = {"shards": None, "engine": "auto", "query_tile": 2048,
               "point_tile": 2048, "bucket_size": 512, "profile_dir": None,
               "timings": False, "checkpoint_dir": None, "checkpoint_every": 1,
-              "write_indices": None, "query_chunk": 0}
+              "write_indices": None, "query_chunk": 0, "selfcheck": 0}
     i = 0
     try:
         while i < len(argv):
@@ -95,6 +98,8 @@ def parse_args(program: str, argv: list[str]):
                 i += 1; extras["write_indices"] = argv[i]
             elif arg == "--query-chunk":
                 i += 1; extras["query_chunk"] = int(argv[i])
+            elif arg == "--selfcheck":
+                i += 1; extras["selfcheck"] = int(argv[i])
             else:
                 usage(program, f"unknown cmdline arg '{arg}'")
             i += 1

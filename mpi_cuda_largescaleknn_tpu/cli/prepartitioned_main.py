@@ -49,6 +49,15 @@ def main(argv: list[str] | None = None) -> int:
         write_rank_file(out_prefix, r, dists)
         if want_idx:
             write_rank_indices(extras["write_indices"], r, idx_lists[r])
+    if extras["selfcheck"] > 0:
+        import numpy as np
+
+        from mpi_cuda_largescaleknn_tpu.obs.selfcheck import verify_sample
+        checked = verify_sample(np.concatenate(partitions),
+                                np.concatenate(results), cfg.k,
+                                extras["selfcheck"],
+                                max_radius=cfg.max_radius)
+        print(f"selfcheck OK ({checked} samples)")
     print("done all queries...")
     if extras["timings"]:
         sys.stderr.write(model.timers.dump() + "\n")

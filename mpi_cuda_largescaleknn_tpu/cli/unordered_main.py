@@ -41,6 +41,11 @@ def main(argv: list[str] | None = None) -> int:
     else:
         dists = got
     write_distances(out_path, dists)
+    if extras["selfcheck"] > 0:
+        from mpi_cuda_largescaleknn_tpu.obs.selfcheck import verify_sample
+        checked = verify_sample(points, dists, cfg.k, extras["selfcheck"],
+                                max_radius=cfg.max_radius)
+        print(f"selfcheck OK ({checked} samples)")
     print("done all queries...")
     if extras["timings"]:
         sys.stderr.write(model.timers.dump() + "\n")

@@ -1,0 +1,72 @@
+"""Post-run sampled verification.
+
+The reference's only verification artifact is a pair of DISABLED probe
+blocks (`#if 0`) that printf every 16·1024-th result for manual diffing
+(unorderedDataVariant.cu:215-227, prePartitionedDataVariant.cu:366-378, with
+a hardcoded 12-rank debug constant). This module is that idea made real: an
+always-available ``--selfcheck N`` that recomputes N sampled points' k-th-NN
+distances exactly (streamed numpy brute force, O(N * n) with bounded memory)
+and fails loudly on mismatch — machine-checked instead of eyeballed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def kth_distance_exact(points: np.ndarray, query_idx: np.ndarray, k: int,
+                       max_radius: float = np.inf,
+                       budget_elems: int = 64_000_000) -> np.ndarray:
+    """Exact k-th-NN distance for ``points[query_idx]`` against ALL points.
+
+    Point blocks are sized so the distance slab stays within
+    ``budget_elems`` f32 elements regardless of the sample count (peak
+    memory a few hundred MB), and per-block selection uses ``np.partition``
+    (linear) rather than a full sort."""
+    q = points[query_idx].astype(np.float32)
+    nq = max(1, len(q))
+    block = max(1024, budget_elems // nq)
+    # running k-smallest per sampled query (unsorted; only the max matters)
+    best = np.full((nq, k), np.float32(max_radius) ** 2, np.float32)
+    for lo in range(0, len(points), block):
+        p = points[lo:lo + block].astype(np.float32)
+        dx = q[:, None, 0] - p[None, :, 0]
+        dy = q[:, None, 1] - p[None, :, 1]
+        dz = q[:, None, 2] - p[None, :, 2]
+        d2 = (dx * dx + dy * dy) + dz * dz
+        cat = np.concatenate([best, d2], axis=1)
+        best = np.partition(cat, k - 1, axis=1)[:, :k]
+    return np.sqrt(best.max(axis=1))
+
+
+def verify_sample(points: np.ndarray, dists: np.ndarray, k: int,
+                  num_samples: int, max_radius: float = np.inf,
+                  seed: int = 0, rtol: float = 1e-5,
+                  atol: float = 1e-6) -> int:
+    """Check ``num_samples`` random outputs against the exact answer.
+
+    Returns the number of samples checked; raises AssertionError with the
+    worst offender on mismatch. Tolerance covers XLA-vs-numpy FMA contraction
+    differences (<= 1 ulp on the squared distances); inf patterns (under-full
+    heaps) must match exactly.
+    """
+    n = len(points)
+    num_samples = min(num_samples, n)
+    rng = np.random.default_rng(seed)
+    idx = rng.choice(n, size=num_samples, replace=False)
+    want = kth_distance_exact(points, idx, k, max_radius)
+    got = np.asarray(dists)[idx]
+    inf_mismatch = np.isinf(got) != np.isinf(want)
+    if inf_mismatch.any():
+        i = int(np.argmax(inf_mismatch))
+        raise AssertionError(
+            f"selfcheck FAILED: point {idx[i]} got {got[i]}, exact {want[i]}")
+    finite = ~np.isinf(want)
+    if not np.allclose(got[finite], want[finite], rtol=rtol, atol=atol):
+        err = np.abs(got[finite] - want[finite])
+        i = int(np.argmax(err))
+        gi = idx[finite][i]
+        raise AssertionError(
+            f"selfcheck FAILED: point {gi} got {got[finite][i]}, "
+            f"exact {want[finite][i]} (|err| {err[i]:.3g})")
+    return num_samples

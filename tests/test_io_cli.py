@@ -150,3 +150,49 @@ class TestWriteIndices:
         np.testing.assert_allclose(
             np.sqrt(full[rows, idx[:, -1]]), d, rtol=1e-6)
         assert np.array_equal(idx[:, 0], rows)  # global ids, self first
+
+
+class TestSelfcheck:
+    def test_selfcheck_passes_on_correct_output(self, tmp_path, capsys):
+        rng = np.random.default_rng(7)
+        pts = rng.random((400, 3)).astype(np.float32)
+        inp = tmp_path / "p.float3"
+        pts.tofile(inp)
+        unordered_main([str(inp), "-o", str(tmp_path / "d.float"), "-k", "6",
+                        "--shards", "4", "--selfcheck", "64"])
+        assert "selfcheck OK (64 samples)" in capsys.readouterr().out
+
+    def test_selfcheck_catches_corruption(self):
+        from mpi_cuda_largescaleknn_tpu.obs.selfcheck import verify_sample
+        rng = np.random.default_rng(9)
+        pts = rng.random((300, 3)).astype(np.float32)
+        good = kth_nn_dist(pts, pts, 5)
+        assert verify_sample(pts, good, 5, 50) == 50
+        bad = good.copy()
+        bad[123] *= 1.5
+        with pytest.raises(AssertionError, match="selfcheck FAILED"):
+            # sample everything so index 123 is always covered
+            verify_sample(pts, bad, 5, 300)
+
+    def test_selfcheck_radius_and_inf(self):
+        from mpi_cuda_largescaleknn_tpu.obs.selfcheck import verify_sample
+        rng = np.random.default_rng(11)
+        pts = (rng.random((200, 3)) * 4).astype(np.float32)
+        r = 0.3
+        want = kth_nn_dist(pts, pts, 8, max_radius=r)
+        assert verify_sample(pts, want, 8, 200, max_radius=r) == 200
+
+
+    def test_selfcheck_inf_pattern_mismatch(self):
+        from mpi_cuda_largescaleknn_tpu.obs.selfcheck import verify_sample
+        rng = np.random.default_rng(13)
+        pts = rng.random((50, 3)).astype(np.float32)
+        # k > n: every output is inf, and that passes
+        want = kth_nn_dist(pts, pts, 60)
+        assert np.all(np.isinf(want))
+        assert verify_sample(pts, want, 60, 50) == 50
+        # a finite value where the exact answer is inf must fail
+        bad = want.copy()
+        bad[7] = 1.0
+        with pytest.raises(AssertionError, match="selfcheck FAILED"):
+            verify_sample(pts, bad, 60, 50)
