@@ -1,0 +1,97 @@
+"""TPU engine/tile tuning sweep — run on a real chip to pick defaults.
+
+One measurement per (engine, n, knobs) combination, each in its OWN
+subprocess so a Mosaic compile failure or tunnel hang costs only that cell
+(the axon tunnel is single-client: never run two of these concurrently).
+
+    python tools/tpu_tune.py             # sweep, prints one JSON line/cell
+    python tools/tpu_tune.py --quick     # smaller sweep
+
+Use the results to set KnnConfig defaults and the bench engine.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+_CHILD = r"""
+import json, sys, time
+import numpy as np
+
+spec = json.loads(sys.argv[1])
+import jax
+
+from mpi_cuda_largescaleknn_tpu.core.config import KnnConfig
+from mpi_cuda_largescaleknn_tpu.models.unordered import UnorderedKNN
+from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+
+n, k = spec["n"], spec["k"]
+pts = np.random.default_rng(7).random((n, 3)).astype(np.float32)
+cfg = KnnConfig(k=k, engine=spec["engine"],
+                bucket_size=spec.get("bucket_size", 512),
+                query_tile=spec.get("query_tile", 2048),
+                point_tile=spec.get("point_tile", 2048))
+model = UnorderedKNN(cfg, mesh=get_mesh(1))
+t0 = time.perf_counter()
+out = model.run(pts)
+compile_s = time.perf_counter() - t0
+best = float("inf")
+for _ in range(2):
+    t0 = time.perf_counter()
+    out = model.run(pts)
+    best = min(best, time.perf_counter() - t0)
+assert np.all(np.isfinite(out))
+print("RESULT " + json.dumps({
+    **spec, "platform": jax.devices()[0].platform,
+    "compile_s": round(compile_s, 2), "seconds": round(best, 4),
+    "qps": round(n / best, 1)}), flush=True)
+"""
+
+
+def main() -> int:
+    quick = "--quick" in sys.argv
+    sizes = [100_000] if quick else [100_000, 1_000_000]
+    cells = []
+    for n in sizes:
+        for engine, knobs in [
+            ("tiled", {"bucket_size": 256}),
+            ("tiled", {"bucket_size": 512}),
+            ("tiled", {"bucket_size": 1024}),
+            ("pallas_tiled", {"bucket_size": 256}),
+            ("pallas_tiled", {"bucket_size": 512}),
+            ("pallas", {"query_tile": 256, "point_tile": 2048}),
+            ("bruteforce", {}),
+        ]:
+            if engine == "bruteforce" and n > 200_000:
+                continue  # O(N^2): hopeless at 1M
+            cells.append({"engine": engine, "n": n, "k": 8, **knobs})
+
+    results = []
+    for spec in cells:
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _CHILD, json.dumps(spec)],
+                timeout=float(os.environ.get("TUNE_TIMEOUT_S", 600)),
+                capture_output=True, text=True, env=dict(os.environ))
+        except subprocess.TimeoutExpired:
+            print(json.dumps({**spec, "error": "timeout"}), flush=True)
+            continue
+        line = next((ln for ln in r.stdout.splitlines()
+                     if ln.startswith("RESULT ")), None)
+        if r.returncode != 0 or line is None:
+            print(json.dumps({**spec,
+                              "error": (r.stderr or "no output")[-400:]}),
+                  flush=True)
+        else:
+            results.append(json.loads(line[len("RESULT "):]))
+            print(json.dumps(results[-1]), flush=True)
+    with open("tpu_tune_report.json", "w") as f:
+        json.dump(results, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
