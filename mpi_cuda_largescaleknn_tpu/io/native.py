@@ -46,6 +46,8 @@ def _load():
         lib.lsk_write_at.restype = ctypes.c_int64
         lib.lsk_write_at.argtypes = [ctypes.c_char_p, ctypes.c_int64,
                                      ctypes.c_int64, ctypes.c_void_p]
+        lib.lsk_create_sized.restype = ctypes.c_int64
+        lib.lsk_create_sized.argtypes = [ctypes.c_char_p, ctypes.c_int64]
         lib.lsk_file_size.restype = ctypes.c_int64
         lib.lsk_file_size.argtypes = [ctypes.c_char_p]
         lib.lsk_partition.restype = ctypes.c_int64
@@ -82,8 +84,20 @@ def native_read_slab(path: str, begin_record: int, num_records: int,
     return out
 
 
+def native_create_sized(path: str, size_bytes: int) -> None:
+    """Create/truncate ``path`` at exactly ``size_bytes`` — run once before
+    concurrent ``native_write_at`` writers so a pre-existing longer file
+    cannot leave stale trailing bytes."""
+    lib = _load()
+    if lib.lsk_create_sized(path.encode(), size_bytes) != 0:
+        raise IOError(f"native create of {path} ({size_bytes} bytes) failed")
+
+
 def native_write_at(path: str, offset_bytes: int, data: np.ndarray) -> None:
-    """Positioned write (concurrent-writer-safe at disjoint offsets)."""
+    """Positioned write (concurrent-writer-safe at disjoint offsets).
+
+    When the target may already exist, pre-size it once with
+    ``native_create_sized`` — this call alone never truncates."""
     lib = _load()
     data = np.ascontiguousarray(data)
     put = lib.lsk_write_at(path.encode(), offset_bytes, data.nbytes,

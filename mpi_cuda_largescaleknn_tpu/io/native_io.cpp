@@ -54,8 +54,23 @@ int64_t lsk_read_at(const char *path, int64_t offset, int64_t count,
   return total;
 }
 
+// Create (or truncate) `path` at exactly `size` bytes, so a set of
+// concurrent lsk_write_at writers covering disjoint slabs produces exactly
+// the intended file — without this step, rewriting an existing LONGER file
+// would leave stale trailing bytes from the prior run. Call once, before
+// the writers start. Returns 0, or -1 on error.
+int64_t lsk_create_sized(const char *path, int64_t size) {
+  int fd = open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return -1;
+  if (ftruncate(fd, size) != 0) { close(fd); return -1; }
+  close(fd);
+  return 0;
+}
+
 // Write `count` bytes from `src` at byte `offset` of `path`, creating the
-// file if needed (safe for concurrent writers at disjoint offsets).
+// file if needed (safe for concurrent writers at disjoint offsets — but the
+// file must be pre-sized with lsk_create_sized first when it may already
+// exist, since O_CREAT without O_TRUNC keeps stale trailing bytes).
 // Returns bytes written, or -1 on error.
 int64_t lsk_write_at(const char *path, int64_t offset, int64_t count,
                      const void *src) {

@@ -19,6 +19,35 @@ def write_distances(path: str, distances: np.ndarray) -> None:
     np.asarray(distances, np.float32).tofile(path)
 
 
+def write_distances_slab(path: str, begin_record: int,
+                         distances: np.ndarray, total_records: int,
+                         presize: bool = False) -> None:
+    """Multi-host output path: each host pwrites its slab of the ONE global
+    ``.float`` file at its record offset — the reference's barrier-fenced
+    rank-serialized append (unorderedDataVariant.cu:229-237) without the
+    serialization. Exactly one writer (by convention host 0) must run with
+    ``presize=True`` before the others write, so a stale longer file from a
+    prior run cannot leave trailing bytes (io/native_io.cpp
+    lsk_create_sized).
+    """
+    from mpi_cuda_largescaleknn_tpu.io import native
+
+    data = np.ascontiguousarray(np.asarray(distances, np.float32))
+    if native.available():
+        if presize:
+            native.native_create_sized(path, total_records * 4)
+        native.native_write_at(path, begin_record * 4, data)
+        return
+    # numpy fallback (no toolchain): plain positioned writes
+    import os
+    if presize or not os.path.exists(path):
+        with open(path, "wb") as f:
+            f.truncate(total_records * 4)
+    with open(path, "r+b") as f:
+        f.seek(begin_record * 4)
+        f.write(data.tobytes())
+
+
 def write_rank_file(prefix: str, rank: int, distances: np.ndarray) -> str:
     """Write one shard's results as ``<prefix>_%06d.float``."""
     path = f"{prefix}_{rank:06d}.float"

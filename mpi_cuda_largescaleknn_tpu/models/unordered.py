@@ -36,6 +36,8 @@ class UnorderedKNN:
         self.mesh = mesh if mesh is not None else get_mesh(
             config.num_shards if config.num_shards > 0 else None)
         self.timers = PhaseTimers()
+        self.last_stats: dict | None = None  # executed-work stats of the
+        # most recent run (pair_evals etc., parallel/ring.py _ring_stats)
 
     def run(self, points: np.ndarray, return_neighbors: bool = False):
         """points f32[N,3] -> f32[N] distance of each point to its k-th NN.
@@ -71,7 +73,7 @@ class UnorderedKNN:
                     chunk_rows=cfg.query_chunk,
                     checkpoint_dir=cfg.checkpoint_dir,
                     checkpoint_every=cfg.checkpoint_every,
-                    return_candidates=return_neighbors)
+                    return_candidates=return_neighbors, return_stats=True)
             elif cfg.checkpoint_dir:
                 got = ring_knn_stepwise(
                     flat, ids, cfg.k, self.mesh, max_radius=cfg.max_radius,
@@ -79,17 +81,17 @@ class UnorderedKNN:
                     point_tile=cfg.point_tile, bucket_size=cfg.bucket_size,
                     checkpoint_dir=cfg.checkpoint_dir,
                     checkpoint_every=cfg.checkpoint_every,
-                    return_candidates=return_neighbors)
+                    return_candidates=return_neighbors, return_stats=True)
             else:
                 got = ring_knn(
                     flat, ids, cfg.k, self.mesh, max_radius=cfg.max_radius,
                     engine=cfg.engine, query_tile=cfg.query_tile,
                     point_tile=cfg.point_tile, bucket_size=cfg.bucket_size,
-                    return_candidates=return_neighbors)
+                    return_candidates=return_neighbors, return_stats=True)
             if return_neighbors:
-                dists, cands = got
+                dists, cands, self.last_stats = got
             else:
-                dists = got
+                dists, self.last_stats = got
             dists = np.asarray(dists)
 
         with self.timers.phase("extract"):

@@ -1,0 +1,52 @@
+"""Analytic cost model: executed distance work -> FLOPs -> MFU estimate.
+
+The reference never measures itself (SURVEY.md §5/§6) — establishing
+roofline-style numbers is this framework's own capability. The unit of work
+is one 3-D squared-distance evaluation (``FLOPS_PER_PAIR`` = 3 sub + 3 mul
++ 2 add = 8 f32 FLOPs); engines report how many pairs they actually scored
+(ops/tiled.py ``with_stats``, parallel/ring.py ``return_stats``; flat
+engines are analytic all-pairs).
+
+The distance tile is elementwise VPU work — there is no matmul in the hot
+loop (a Gram-matrix ``-2 q·p`` MXU formulation wastes the 128-wide
+contraction on K=3) — so MFU is measured against the chip's VECTOR unit
+peak, not the headline MXU number. The candidate-row merge (sorts,
+compares) is real additional work not counted here: the estimate is a
+LOWER bound on achieved utilization.
+
+Per-chip vector-peak assumptions are order-of-magnitude from public specs
+and overridable with ``LSK_PEAK_FLOPS`` (f32 FLOP/s); every report carries
+the assumed peak so nothing is presented as more precise than it is.
+"""
+
+from __future__ import annotations
+
+import os
+
+FLOPS_PER_PAIR = 8  # 3 sub + 3 mul + 2 add per 3-D squared distance
+
+# assumed peak VECTOR f32 FLOP/s per chip (see module docstring)
+_PEAK_VPU_F32 = {
+    "tpu": 4.0e12,   # TPU v4/v5-class VPU order of magnitude
+    "cpu": 1.0e11,   # one AVX-ish host core pool, for labeled fallbacks
+}
+
+
+def peak_flops(platform: str) -> float:
+    env = os.environ.get("LSK_PEAK_FLOPS")
+    if env:
+        return float(env)
+    return _PEAK_VPU_F32.get(platform, _PEAK_VPU_F32["tpu"])
+
+
+def cost_report(pair_evals: int, seconds: float, platform: str) -> dict:
+    """{device flop estimate, pair-eval throughput, MFU vs vector peak}."""
+    flops = pair_evals * FLOPS_PER_PAIR
+    peak = peak_flops(platform)
+    return {
+        "pair_evals": int(pair_evals),
+        "pair_evals_per_sec": round(pair_evals / seconds, 1) if seconds else 0.0,
+        "distance_flops": int(flops),
+        "assumed_peak_flops": peak,
+        "mfu_estimate": round(flops / seconds / peak, 4) if seconds else 0.0,
+    }

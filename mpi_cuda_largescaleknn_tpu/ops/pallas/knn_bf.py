@@ -176,8 +176,11 @@ def knn_update_pallas(state: CandidateState, queries: jnp.ndarray,
     if point_ids is None:
         point_ids = jnp.arange(num_p, dtype=jnp.int32)
 
-    qt = min(query_tile, max(8, num_q))
-    pt = min(point_tile, max(128, num_p))
+    # clamp to the problem size, then round UP to Mosaic-lowerable block
+    # shapes (sublane multiple of 8, lane multiple of 128 for f32) — small
+    # or odd N otherwise compiles in interpret mode but fails on real TPUs
+    qt = cdiv(min(query_tile, max(8, num_q)), 8) * 8
+    pt = cdiv(min(point_tile, max(128, num_p)), 128) * 128
     nq_pad = cdiv(num_q, qt) * qt
     np_pad = cdiv(num_p, pt) * pt
 

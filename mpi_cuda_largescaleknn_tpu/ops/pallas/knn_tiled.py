@@ -51,6 +51,7 @@ def _kernel(order_ref, boxd2_ref,            # SMEM: [1, Bp] i32 / f32
             in_d2_ref, in_idx_ref,           # VMEM: [S, k]
             p_hbm, pid_hbm,                  # ANY (HBM): [Bp, 3, T] / [Bp, 1, T]
             out_d2_ref, out_idx_ref,         # VMEM: [S, k]
+            vis_ref,                         # SMEM: [1, 1] i32 visits
             p_buf, id_buf, sems):            # scratch: [2,3,T], [2,1,T], (2,2)
     num_pb = p_hbm.shape[0]
     q = q_ref[0]                             # [S, 3]
@@ -116,6 +117,7 @@ def _kernel(order_ref, boxd2_ref,            # SMEM: [1, Bp] i32 / f32
 
     out_d2_ref[:] = cd2
     out_idx_ref[:] = cidx
+    vis_ref[0, 0] = s_exit  # buckets this query bucket actually scored
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -124,7 +126,7 @@ def _run(order, boxd2, q_pts, q_ids, in_d2, in_idx, p_t, pid_t, *, interpret):
     num_pb, _, t_p = p_t.shape
     k = in_d2.shape[-1]
     grid = (num_qb,)
-    out_d2, out_idx = pl.pallas_call(
+    out_d2, out_idx, visits = pl.pallas_call(
         _kernel,
         grid=grid,
         in_specs=[
@@ -148,6 +150,8 @@ def _run(order, boxd2, q_pts, q_ids, in_d2, in_idx, p_t, pid_t, *, interpret):
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((s_q, k), lambda b: (b, 0),
                          memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda b: (b, 0),
+                         memory_space=pltpu.SMEM),
         ),
         out_shape=(
             # under shard_map the outputs vary over the same mesh axes as the
@@ -156,6 +160,9 @@ def _run(order, boxd2, q_pts, q_ids, in_d2, in_idx, p_t, pid_t, *, interpret):
                                  vma=getattr(jax.typeof(in_d2), "vma",
                                              frozenset())),
             jax.ShapeDtypeStruct((num_qb * s_q, k), jnp.int32,
+                                 vma=getattr(jax.typeof(in_idx), "vma",
+                                             frozenset())),
+            jax.ShapeDtypeStruct((num_qb, 1), jnp.int32,
                                  vma=getattr(jax.typeof(in_idx), "vma",
                                              frozenset())),
         ),
@@ -168,14 +175,18 @@ def _run(order, boxd2, q_pts, q_ids, in_d2, in_idx, p_t, pid_t, *, interpret):
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(order, boxd2, q_pts, q_ids, in_d2, in_idx, p_t, pid_t)
-    return out_d2, out_idx
+    return out_d2, out_idx, visits
 
 
 def knn_update_tiled_pallas(state: CandidateState, q: BucketedPoints,
                             p: BucketedPoints, *,
-                            interpret: bool | None = None) -> CandidateState:
+                            interpret: bool | None = None,
+                            with_stats: bool = False):
     """Drop-in Pallas twin of ``ops.tiled.knn_update_tiled`` (same contract:
-    state rows in ``q``'s bucket order; folds every real point of ``p`` in)."""
+    state rows in ``q``'s bucket order; folds every real point of ``p`` in;
+    ``with_stats`` additionally returns the i32 count of [S, T] tiles
+    scored — here the sum over query buckets of buckets each visited, since
+    every bucket advances independently instead of lock-stepping)."""
     if interpret is None:
         from mpi_cuda_largescaleknn_tpu.ops.pallas import is_tpu_backend
         interpret = not is_tpu_backend()
@@ -190,6 +201,10 @@ def knn_update_tiled_pallas(state: CandidateState, q: BucketedPoints,
 
     assert state.dist2.shape == (num_qb * s_q, k), (state.dist2.shape,
                                                     (num_qb, s_q, k))
-    out_d2, out_idx = _run(order, sorted_d2, q.pts, q.ids, state.dist2,
-                           state.idx, p_t, pid_t, interpret=interpret)
-    return CandidateState(out_d2, out_idx)
+    out_d2, out_idx, visits = _run(order, sorted_d2, q.pts, q.ids,
+                                   state.dist2, state.idx, p_t, pid_t,
+                                   interpret=interpret)
+    out = CandidateState(out_d2, out_idx)
+    if with_stats:
+        return out, jnp.sum(visits).astype(jnp.int32)
+    return out

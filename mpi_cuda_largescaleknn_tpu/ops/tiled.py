@@ -60,13 +60,16 @@ def _worst2(hd2: jnp.ndarray, qvalid: jnp.ndarray) -> jnp.ndarray:
 
 
 def knn_update_tiled(state: CandidateState, q: BucketedPoints,
-                     p: BucketedPoints, *, chunk_buckets: int | None = None
-                     ) -> CandidateState:
+                     p: BucketedPoints, *, chunk_buckets: int | None = None,
+                     with_stats: bool = False):
     """Fold every real point of ``p`` into the candidate state (one
     reference ``runQuery`` launch, at bucket granularity).
 
     ``state`` rows are in ``q``'s bucket order: row ``b * S + i`` is query
-    ``q.pts[b, i]``. Returns the updated state in the same order.
+    ``q.pts[b, i]``. Returns the updated state in the same order; with
+    ``with_stats`` also an i32 count of [S, T] distance tiles actually
+    computed (chunks skipped by the all-pruned ``lax.cond`` don't count),
+    from which callers derive executed distance evaluations / FLOPs.
     """
     num_qb, s_q = q.ids.shape
     num_pb, s_p = p.ids.shape
@@ -86,13 +89,13 @@ def knn_update_tiled(state: CandidateState, q: BucketedPoints,
     q_chunked = q.pts.reshape(n_chunks, chunk, s_q, 3)
 
     def cond(carry):
-        _hd2, _hidx, worst2, step = carry
+        _hd2, _hidx, worst2, step, _tiles = carry
         next_d2 = lax.dynamic_index_in_dim(sorted_d2, jnp.minimum(
             step, num_pb - 1), axis=1, keepdims=False)
         return (step < num_pb) & jnp.any(next_d2 < worst2)
 
     def body(carry):
-        hd2, hidx, worst2, step = carry
+        hd2, hidx, worst2, step, tiles = carry
         visit = lax.dynamic_index_in_dim(order, step, axis=1, keepdims=False)
         visit_d2 = lax.dynamic_index_in_dim(sorted_d2, step, axis=1,
                                             keepdims=False)
@@ -135,9 +138,23 @@ def knn_update_tiled(state: CandidateState, q: BucketedPoints,
             hidx.reshape(n_chunks, chunk, s_q, k)))
         hd2 = hd2.reshape(num_qb, s_q, k)
         hidx = hidx.reshape(num_qb, s_q, k)
-        return hd2, hidx, _worst2(hd2, qvalid), step + 1
+        # tiles executed this step: skipped chunks contribute 0, a computed
+        # chunk contributes its full `chunk` buckets (masked-out buckets in
+        # an active chunk still burn VPU work — count what ran, not what
+        # was useful)
+        act_c = active.reshape(n_chunks, chunk)
+        tiles = tiles + jnp.sum(
+            jnp.where(jnp.any(act_c, axis=1), chunk, 0)).astype(jnp.int32)
+        return hd2, hidx, _worst2(hd2, qvalid), step + 1, tiles
 
-    init = (hd2, hidx, _worst2(hd2, qvalid), jnp.int32(0))
-    hd2, hidx, _, _ = lax.while_loop(cond, body, init)
-    return CandidateState(hd2.reshape(num_qb * s_q, k),
-                          hidx.reshape(num_qb * s_q, k))
+    # derive the zero from the heap so the counter carries the same
+    # varying-manual-axes type as the rest of the carry under shard_map
+    # (a fresh constant would be replicated and trip the vma checker);
+    # a comparison, not a multiply: hd2 starts at cutoff^2 = inf by default
+    # and inf * 0 is NaN, whose int cast is backend-defined
+    tiles0 = (hd2[0, 0, 0] < 0).astype(jnp.int32)
+    init = (hd2, hidx, _worst2(hd2, qvalid), jnp.int32(0), tiles0)
+    hd2, hidx, _, _, tiles = lax.while_loop(cond, body, init)
+    out = CandidateState(hd2.reshape(num_qb * s_q, k),
+                         hidx.reshape(num_qb * s_q, k))
+    return (out, tiles) if with_stats else out

@@ -306,6 +306,7 @@ def demand_knn_stepwise(points_sharded: jnp.ndarray,
         fp = ckpt.fingerprint(
             n=int(pts.shape[0]), k=int(k), shards=num_shards, engine=engine,
             max_radius=float(max_radius), bucket_size=bucket_size,
+            query_tile=query_tile, point_tile=point_tile,
             kind="demand", data=ckpt.data_digest(points_sharded, ids_sharded))
         got = ckpt.load_pytree(checkpoint_dir, fp,
                                (shard_state, heap, nrun), sharding)
@@ -316,29 +317,33 @@ def demand_knn_stepwise(points_sharded: jnp.ndarray,
         np.full(num_shards, start, np.int32), sharding)
     rounds_done = start
     stop = num_shards if max_rounds is None else min(max_rounds, num_shards)
+    # "completed" = nothing left to do (early exit fired, or every shard
+    # visited) — as opposed to merely truncated by the max_rounds cap
+    completed = start >= num_shards
     finished = start >= stop
     while not finished:
         shard_state, heap, rnd_arr, nrun, kg = step(
             ctx, shard_state, heap, rnd_arr, nrun)
         rounds_done += 1
         keep_going = bool(np.asarray(kg)[0])
-        finished = (not keep_going) or rounds_done >= stop
-        # no final save on a naturally-completing run (max_rounds unset):
-        # it would be cleared moments later — pure wasted sync + disk IO
-        want_final_save = finished and max_rounds is not None
-        if checkpoint_dir and (rounds_done % checkpoint_every == 0
-                               or want_final_save):
+        completed = (not keep_going) or rounds_done >= num_shards
+        finished = completed or rounds_done >= stop
+        # completed runs skip the final save (their checkpoint is cleared
+        # below — saving it would be wasted sync + disk IO, and a stale
+        # save would make a relaunch redo already-pruned rounds); runs
+        # truncated by the round cap always save so a relaunch resumes
+        if checkpoint_dir and ((rounds_done % checkpoint_every == 0
+                                and not completed)
+                               or (finished and not completed)):
             ckpt.save_pytree(checkpoint_dir, rounds_done,
                              (shard_state, heap, nrun), fp)
-        if not keep_going:
-            break
 
     d, hd2, hidx = smap(
         lambda c, h: _trim_rows(*final_fn(c, h), npad), 2,
         (spec, spec, spec))(ctx, heap)
     # completed runs clear their checkpoint (stale-state safety); runs
     # truncated by max_rounds keep it so a relaunch resumes
-    if checkpoint_dir and max_rounds is None:
+    if checkpoint_dir and completed:
         ckpt.clear(checkpoint_dir)
     if return_stats:
         return (np.asarray(d), CandidateState(np.asarray(hd2),

@@ -23,12 +23,51 @@ device order, so the ring permutation rides neighbor links.
 from __future__ import annotations
 
 import os
+import threading
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
 
 AXIS = "shards"  # the single mesh axis name used by the engines
+
+
+def acquire_devices(timeout_s: float | None = None):
+    """``jax.devices()`` behind a watchdog so a wedged accelerator tunnel
+    fails fast with an actionable message instead of hanging a user CLI.
+
+    Default budget is 300 s (env ``LSK_DEVICE_TIMEOUT_S``): first contact
+    through the single-client TPU tunnel takes 60-240+ s even when healthy
+    (the same window the bench probes allow), so a shorter default would
+    kill healthy runs that a longer probe just admitted. Once the backend
+    is up, subsequent calls return instantly. For a fast CPU run use
+    ``env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu`` (no tunnel dial at
+    all) rather than a short timeout.
+    """
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("LSK_DEVICE_TIMEOUT_S", 300))
+    got: list = []
+    err: list = []
+
+    def work():
+        try:
+            got.append(jax.devices())
+        except Exception as e:  # noqa: BLE001 - re-raised on the main thread
+            err.append(e)
+
+    t = threading.Thread(target=work, daemon=True, name="lsk-device-probe")
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise TimeoutError(
+            f"no JAX devices after {timeout_s:.0f}s — the accelerator "
+            "tunnel may be down or held by another client (it is "
+            "single-client). Workarounds: run on CPU with "
+            "`env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu`, or raise "
+            "LSK_DEVICE_TIMEOUT_S.")
+    if err:
+        raise err[0]
+    return got[0]
 
 
 def initialize_distributed(coordinator: str | None = None,
@@ -53,7 +92,7 @@ def get_mesh(num_shards: int | None = None) -> Mesh:
     The mesh axis plays the role of the MPI communicator: axis index == rank,
     axis size == world size.
     """
-    devices = jax.devices()
+    devices = acquire_devices()
     if num_shards is None:
         num_shards = len(devices)
     if num_shards > len(devices):

@@ -107,8 +107,11 @@ def _make_ring_fns(k, max_radius, engine, query_tile, point_tile, bucket_size,
     - shard_init_fn(pts_local, ids_local) -> shard (tree side only)
     - query_init_fn(qpts_local, qids_local) -> (stationary, heap)
       (query side only — may be a chunk of the slab)
-    - round_fn(stationary, shard, heap) -> (next_shard, new_heap)
-      (issues the rotation before the fold so XLA overlaps them)
+    - round_fn(stationary, shard, heap) -> (next_shard, new_heap, tiles)
+      (issues the rotation before the fold so XLA overlaps them; ``tiles``
+      is i32[1]: distance tiles this device actually computed — real counts
+      for the pruned tiled engines, 0 for flat engines whose all-pairs count
+      is analytic and added by the drivers)
     - final_fn(stationary, heap, npad) -> (dists, hd2, hidx) in input-row
       order per shard
     """
@@ -133,7 +136,8 @@ def _make_ring_fns(k, max_radius, engine, query_tile, point_tile, bucket_size,
             # query-side-only metadata, ids stand in for it
             resident = BucketedPoints(shard[0], shard[1], shard[2], shard[3],
                                       shard[1])
-            return nxt, tiled_update(heap, q, resident)
+            st, tiles = tiled_update(heap, q, resident, with_stats=True)
+            return nxt, st, tiles[None]
 
         def final_fn(q, heap, npad):
             kk = heap.dist2.shape[-1]
@@ -169,7 +173,10 @@ def _make_ring_fns(k, max_radius, engine, query_tile, point_tile, bucket_size,
         def round_fn(queries, shard, heap):
             nxt = jax.tree.map(lambda a: jax.lax.ppermute(a, AXIS, fwd),
                                shard)
-            return nxt, update(heap, queries, shard[0], shard[1])
+            st = update(heap, queries, shard[0], shard[1])
+            # flat engines score every pair: the count is analytic
+            # (n_q * n_p per device-round), added host-side by the drivers
+            return nxt, st, pvary(jnp.zeros((1,), jnp.int32))
 
         def final_fn(_queries, heap, _npad):
             return extract_final_result(heap), heap.dist2, heap.idx
@@ -186,10 +193,33 @@ def _make_ring_fns(k, max_radius, engine, query_tile, point_tile, bucket_size,
     return init_fn, round_fn, final_fn, shard_init_fn, query_init_fn
 
 
+def _ring_stats(engine: str, tiles_total: int, bucket_size: int,
+                n_q_device_rounds: int) -> dict:
+    """Executed-work stats: distance pairs actually scored.
+
+    Tiled engines report measured tile counts (pruning makes the count
+    data-dependent); flat engines score every pair, so the count is
+    analytic: ``n_q_device_rounds`` = sum over device-rounds of
+    n_queries_local * n_points_local."""
+    use_tiled = engine in ("tiled", "auto", "pallas_tiled")
+    if use_tiled:
+        pair_evals = int(tiles_total) * bucket_size * bucket_size
+    elif engine == "tree":
+        # the stack-free traversal is bounds-pruned and uninstrumented:
+        # all-pairs would overstate executed work by orders of magnitude
+        return {"pair_evals": 0, "tiles": 0, "flops_per_pair": 8,
+                "note": "tree engine work is pruned and not counted"}
+    else:
+        pair_evals = int(n_q_device_rounds)
+    return {"pair_evals": pair_evals, "tiles": int(tiles_total),
+            "flops_per_pair": 8}
+
+
 def ring_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
              mesh, *, max_radius: float = jnp.inf, engine: str = "auto",
              query_tile: int = 2048, point_tile: int = 2048,
-             bucket_size: int = 512, return_candidates: bool = False):
+             bucket_size: int = 512, return_candidates: bool = False,
+             return_stats: bool = False):
     """Run the full R-round ring on a 1-D mesh (fused ``lax.fori_loop``).
 
     Args:
@@ -215,14 +245,16 @@ def ring_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
         stationary, shard, heap = init_fn(pts_local, ids_local)
 
         def round_body(_i, carry):
-            shard, hd2, hidx = carry
-            nxt, st = round_fn(stationary, shard, CandidateState(hd2, hidx))
-            return nxt, st.dist2, st.idx
+            shard, hd2, hidx, tiles = carry
+            nxt, st, t = round_fn(stationary, shard,
+                                  CandidateState(hd2, hidx))
+            return nxt, st.dist2, st.idx, tiles + t[0]
 
-        _, hd2, hidx = jax.lax.fori_loop(
-            0, num_shards, round_body, (shard, heap.dist2, heap.idx))
+        _, hd2, hidx, tiles = jax.lax.fori_loop(
+            0, num_shards, round_body,
+            (shard, heap.dist2, heap.idx, pvary(jnp.int32(0))))
         return final_fn(stationary, CandidateState(hd2, hidx),
-                        pts_local.shape[0])
+                        pts_local.shape[0]) + (tiles[None],)
 
     shard_spec = P(AXIS)
     # interpret-mode pallas kernels re-evaluate a vma-less kernel jaxpr with
@@ -231,16 +263,22 @@ def ring_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
     mapped = jax.jit(jax.shard_map(
         body, mesh=mesh,
         in_specs=(shard_spec, shard_spec),
-        out_specs=(shard_spec, shard_spec, shard_spec),
+        out_specs=(shard_spec, shard_spec, shard_spec, shard_spec),
         check_vma=not engine.startswith("pallas")))
 
     sharding = NamedSharding(mesh, shard_spec)
     points_sharded = jax.device_put(points_sharded, sharding)
     ids_sharded = jax.device_put(ids_sharded, sharding)
-    dists, hd2, hidx = mapped(points_sharded, ids_sharded)
+    dists, hd2, hidx, tiles = mapped(points_sharded, ids_sharded)
+    out = (dists,)
     if return_candidates:
-        return dists, CandidateState(hd2, hidx)
-    return dists
+        out += (CandidateState(hd2, hidx),)
+    if return_stats:
+        npad_local = points_sharded.shape[0] // num_shards
+        out += (_ring_stats(
+            engine, int(np.asarray(tiles).sum()), bucket_size,
+            num_shards * num_shards * npad_local * npad_local),)
+    return out if len(out) > 1 else out[0]
 
 
 def ring_knn_stepwise(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
@@ -250,7 +288,8 @@ def ring_knn_stepwise(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
                       checkpoint_dir: str | None = None,
                       checkpoint_every: int = 1,
                       max_rounds: int | None = None,
-                      return_candidates: bool = False):
+                      return_candidates: bool = False,
+                      return_stats: bool = False):
     """``ring_knn`` with host-controlled rounds + checkpoint/resume.
 
     Identical results to ``ring_knn`` (literally the same ``_make_ring_fns``
@@ -293,10 +332,11 @@ def ring_knn_stepwise(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
         fp = ckpt.fingerprint(
             n=int(pts.shape[0]), k=int(k), shards=num_shards, engine=engine,
             max_radius=float(max_radius), bucket_size=bucket_size,
+            query_tile=query_tile, point_tile=point_tile,
             data=ckpt.data_digest(points_sharded, ids_sharded))
 
     stationary, shard, heap = smap(init_fn, 2, (spec, spec, spec))(pts, ids)
-    step = smap(round_fn, 3, (spec, spec))
+    step = smap(round_fn, 3, (spec, spec, spec))
 
     start = 0
     if checkpoint_dir:
@@ -304,9 +344,14 @@ def ring_knn_stepwise(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
         if got is not None:
             start, (shard, heap) = got
 
+    tiles_parts = []  # device arrays; materialized ONCE after the loop so
+    rounds_run = 0    # the non-stats path keeps its async round dispatch
     stop = num_shards if max_rounds is None else min(max_rounds, num_shards)
     for r in range(start, stop):
-        shard, heap = step(stationary, shard, heap)
+        shard, heap, tiles = step(stationary, shard, heap)
+        if return_stats:
+            tiles_parts.append(tiles)
+        rounds_run += 1
         if checkpoint_dir and ((r + 1) % checkpoint_every == 0
                                or r + 1 == stop):
             ckpt.save_pytree(checkpoint_dir, r + 1, (shard, heap), fp)
@@ -318,9 +363,15 @@ def ring_knn_stepwise(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
         # done: clear so a later (possibly different-data) run in the same
         # dir can never resume past its own work
         ckpt.clear(checkpoint_dir)
+    out = (np.asarray(dists),)
     if return_candidates:
-        return np.asarray(dists), CandidateState(hd2, hidx)
-    return np.asarray(dists)
+        out += (CandidateState(hd2, hidx),)
+    if return_stats:
+        tiles_total = int(np.sum([np.asarray(t).sum() for t in tiles_parts]))
+        out += (_ring_stats(
+            engine, tiles_total, bucket_size,
+            rounds_run * num_shards * npad_local * npad_local),)
+    return out if len(out) > 1 else out[0]
 
 
 def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
@@ -331,7 +382,8 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
                      checkpoint_dir: str | None = None,
                      checkpoint_every: int = 1,
                      max_chunks: int | None = None,
-                     return_candidates: bool = False):
+                     return_candidates: bool = False,
+                     return_stats: bool = False):
     """``ring_knn`` with the query side streamed in fixed-size chunks.
 
     The memory wall at reference scale is the candidate heaps, not the
@@ -379,7 +431,7 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
         jax.device_put(points_sharded, sharding),
         jax.device_put(ids_sharded, sharding))
     qinit = smap(query_init_fn, 2, (spec, spec))
-    step = smap(round_fn, 3, (spec, spec))
+    step = smap(round_fn, 3, (spec, spec, spec))
     final = smap(lambda s, h: final_fn(s, h, chunk_rows), 2,
                  (spec, spec, spec))
 
@@ -399,6 +451,7 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
             n=int(points_sharded.shape[0]), k=int(k), shards=num_shards,
             engine=engine, max_radius=float(max_radius),
             bucket_size=bucket_size, chunk_rows=chunk_rows,
+            query_tile=query_tile, point_tile=point_tile,
             candidates=bool(return_candidates),
             data=ckpt.data_digest(points_sharded, ids_sharded))
         got = ckpt.load_ring_state(checkpoint_dir, fp)
@@ -411,6 +464,8 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
     # absolute cap, consistent with the stepwise drivers' max_rounds
     stop_chunk = (n_chunks if max_chunks is None
                   else min(max_chunks, n_chunks))
+    tiles_parts = []  # materialized once at the end (see ring_knn_stepwise)
+    chunks_run = 0
     for c in range(start_chunk, stop_chunk):
         lo = c * chunk_rows
         hi = min(lo + chunk_rows, npad_local)
@@ -421,8 +476,11 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
         stationary, heap = qinit(
             jax.device_put(qp.reshape(-1, 3), sharding),
             jax.device_put(qi.reshape(-1), sharding))
+        chunks_run += 1
         for _r in range(num_shards):
-            shard, heap = step(stationary, shard, heap)
+            shard, heap, tiles = step(stationary, shard, heap)
+            if return_stats:
+                tiles_parts.append(tiles)
         d, hd2, hidx = final(stationary, heap)
         d = np.asarray(d).reshape(num_shards, chunk_rows)
         out_d[:, lo:hi] = d[:, :hi - lo]
@@ -444,7 +502,13 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
     if checkpoint_dir and stop_chunk == n_chunks:
         ckpt.clear(checkpoint_dir)
     dists = out_d.reshape(-1)
+    out = (dists,)
     if return_candidates:
-        return dists, CandidateState(out_hd2.reshape(-1, k),
-                                     out_idx.reshape(-1, k))
-    return dists
+        out += (CandidateState(out_hd2.reshape(-1, k),
+                               out_idx.reshape(-1, k)),)
+    if return_stats:
+        tiles_total = int(np.sum([np.asarray(t).sum() for t in tiles_parts]))
+        out += (_ring_stats(
+            engine, tiles_total, bucket_size,
+            chunks_run * num_shards * num_shards * chunk_rows * npad_local),)
+    return out if len(out) > 1 else out[0]

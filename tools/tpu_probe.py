@@ -1,0 +1,123 @@
+"""One-shot TPU health + Mosaic-compile probe.
+
+Single process (the axon tunnel is single-client): times first device
+contact, runs a matmul sanity check, then compiles + runs BOTH Pallas
+kernels with ``interpret=False`` at small aligned sizes. Prints one JSON
+line per stage so a hang is attributable, and a final ``PROBE`` summary.
+
+    python -u tools/tpu_probe.py 2>probe.err >probe.out
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import traceback
+
+REPORT = {}
+
+
+def stage(name):
+    def deco(fn):
+        t0 = time.time()
+        try:
+            out = fn()
+            REPORT[name] = {"ok": True, "seconds": round(time.time() - t0, 1),
+                            **(out or {})}
+        except Exception as e:  # noqa: BLE001
+            REPORT[name] = {"ok": False,
+                            "seconds": round(time.time() - t0, 1),
+                            "error": f"{type(e).__name__}: {e}"[:800]}
+            traceback.print_exc()
+        print("STAGE " + json.dumps({name: REPORT[name]}), flush=True)
+        return REPORT[name]["ok"]
+    return deco
+
+
+def main():
+    import numpy as np
+
+    t0 = time.time()
+    import jax
+
+    @stage("contact")
+    def _contact():
+        d = jax.devices()
+        return {"platform": d[0].platform, "n_devices": len(d),
+                "device": str(d[0]),
+                "import_plus_devices_s": round(time.time() - t0, 1)}
+
+    on_tpu = REPORT["contact"].get("ok") and \
+        REPORT["contact"].get("platform") not in (None, "cpu")
+
+    @stage("matmul")
+    def _matmul():
+        import jax.numpy as jnp
+        x = jnp.ones((1024, 1024), jnp.float32)
+        y = (x @ x).block_until_ready()
+        t1 = time.time()
+        for _ in range(10):
+            y = (y @ x) / 1024.0
+        y.block_until_ready()
+        return {"ten_matmuls_s": round(time.time() - t1, 4),
+                "check": float(y[0, 0])}
+
+    @stage("pallas_bf")
+    def _bf():
+        from mpi_cuda_largescaleknn_tpu.ops.pallas.knn_bf import (
+            knn_update_pallas,
+        )
+        from mpi_cuda_largescaleknn_tpu.ops.candidates import init_candidates
+        rng = np.random.default_rng(0)
+        q = rng.random((1024, 3)).astype(np.float32)
+        p = rng.random((4096, 3)).astype(np.float32)
+        st = init_candidates(1024, 8)
+        t1 = time.time()
+        out = knn_update_pallas(st, q, p, query_tile=256, point_tile=2048,
+                                interpret=not on_tpu)
+        out.dist2.block_until_ready()
+        compile_s = time.time() - t1
+        # correctness vs brute force on the first 4 queries
+        d2 = ((q[:4, None, :] - p[None, :, :]) ** 2).sum(-1)
+        ref = np.sort(d2, axis=1)[:, :8]
+        got = np.asarray(out.dist2[:4])
+        assert np.allclose(np.sort(got, axis=1), ref, rtol=1e-5, atol=1e-6), \
+            (got, ref)
+        t2 = time.time()
+        out = knn_update_pallas(st, q, p, query_tile=256, point_tile=2048,
+                                interpret=not on_tpu)
+        out.dist2.block_until_ready()
+        return {"compile_s": round(compile_s, 2),
+                "steady_s": round(time.time() - t2, 4)}
+
+    @stage("pallas_tiled")
+    def _tiled():
+        from mpi_cuda_largescaleknn_tpu.ops.candidates import init_candidates
+        from mpi_cuda_largescaleknn_tpu.ops.partition import partition_points
+        from mpi_cuda_largescaleknn_tpu.ops.pallas.knn_tiled import (
+            knn_update_tiled_pallas,
+        )
+        from mpi_cuda_largescaleknn_tpu.ops.tiled import knn_update_tiled
+        rng = np.random.default_rng(1)
+        pts = rng.random((8192, 3)).astype(np.float32)
+        q = partition_points(pts, bucket_size=256)
+        st = init_candidates(q.num_buckets * q.bucket_size, 8)
+        t1 = time.time()
+        out = knn_update_tiled_pallas(st, q, q, interpret=not on_tpu)
+        out.dist2.block_until_ready()
+        compile_s = time.time() - t1
+        ref = knn_update_tiled(st, q, q)
+        assert np.allclose(np.asarray(out.dist2), np.asarray(ref.dist2),
+                           rtol=1e-5, atol=1e-6)
+        t2 = time.time()
+        out = knn_update_tiled_pallas(st, q, q, interpret=not on_tpu)
+        out.dist2.block_until_ready()
+        return {"compile_s": round(compile_s, 2),
+                "steady_s": round(time.time() - t2, 4)}
+
+    REPORT["on_tpu"] = bool(on_tpu)
+    print("PROBE " + json.dumps(REPORT), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,15 @@
+#!/bin/bash
+# Retry TPU contact until the single-client tunnel comes back, then run the
+# full Mosaic-compile probe (tools/tpu_probe.py) once and exit 0.
+cd /root/repo
+for i in $(seq 1 40); do
+  echo "attempt $i: $(date -u +%H:%M:%S)" >> tpu_watch.log
+  timeout 900 python -u tools/tpu_probe.py > tpu_probe.out 2> tpu_probe.err
+  if grep -q '"on_tpu": true' tpu_probe.out 2>/dev/null; then
+    echo "TPU UP at $(date -u +%H:%M:%S)" >> tpu_watch.log
+    exit 0
+  fi
+  sleep 240
+done
+echo "gave up $(date -u +%H:%M:%S)" >> tpu_watch.log
+exit 1
