@@ -41,6 +41,11 @@ TPU-side options (no reference analogue):
                     file P in global point order; prepartitioned -> one
                     P_%06d.int32 per shard. The reference computes these but
                     discards them (unorderedDataVariant.cu extractFinalResult)
+  --coordinator A   multi-host: coordinator address host:port (the reference's
+                    mpirun; here jax.distributed). Launch ONE copy of this CLI
+                    per host with the same args plus --host-id
+  --num-hosts N     multi-host: number of cooperating processes
+  --host-id I       multi-host: this process's id in [0, N)
 """
 
 
@@ -61,7 +66,8 @@ def parse_args(program: str, argv: list[str]):
     extras = {"shards": None, "engine": "auto", "query_tile": 2048,
               "point_tile": 2048, "bucket_size": 512, "profile_dir": None,
               "timings": False, "checkpoint_dir": None, "checkpoint_every": 1,
-              "write_indices": None, "query_chunk": 0, "selfcheck": 0}
+              "write_indices": None, "query_chunk": 0, "selfcheck": 0,
+              "coordinator": None, "num_hosts": 1, "host_id": 0}
     i = 0
     try:
         while i < len(argv):
@@ -100,6 +106,12 @@ def parse_args(program: str, argv: list[str]):
                 i += 1; extras["query_chunk"] = int(argv[i])
             elif arg == "--selfcheck":
                 i += 1; extras["selfcheck"] = int(argv[i])
+            elif arg == "--coordinator":
+                i += 1; extras["coordinator"] = argv[i]
+            elif arg == "--num-hosts":
+                i += 1; extras["num_hosts"] = int(argv[i])
+            elif arg == "--host-id":
+                i += 1; extras["host_id"] = int(argv[i])
             else:
                 usage(program, f"unknown cmdline arg '{arg}'")
             i += 1

@@ -1,0 +1,181 @@
+"""Multi-host (pod-scale) driver for the unordered pipeline.
+
+The reference scales across nodes with ``mpirun -n R``: every rank reads
+ONLY its slab of the input (unorderedDataVariant.cu:145-148) and appends
+ONLY its slab of the output, barrier-fenced in rank order (:229-237) — no
+node ever holds the whole dataset. This is that contract at pod scale:
+
+- one copy of the CLI per host (``--coordinator/--num-hosts/--host-id``,
+  the mpirun lifecycle as ``jax.distributed.initialize``);
+- each host preads only the slabs of the mesh positions its local devices
+  own (io/native.py threaded pread) and assembles its process-local block
+  of the global sharded array (``jax.make_array_from_process_local_data``);
+- the ring runs as ONE jitted SPMD program over the global mesh — the
+  collectives ride ICI/DCN, no host ever sees remote rows;
+- each host pwrites its result slabs at their byte offsets into the ONE
+  output file (io/writer.py ``write_distances_slab``; host 0 pre-sizes,
+  a global sync fences the concurrent writers — the reference's barrier
+  serialization made parallel).
+
+Validated off-pod by the 2-process CPU-mesh integration test
+(tests/test_multihost.py): byte-identical output to a single-process run
+with the same shard count.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from mpi_cuda_largescaleknn_tpu.core.config import KnnConfig
+from mpi_cuda_largescaleknn_tpu.io.reader import read_file_portion
+from mpi_cuda_largescaleknn_tpu.io.writer import write_distances_slab
+from mpi_cuda_largescaleknn_tpu.models.sharding import (
+    pad_and_flatten,
+    slab_bounds,
+)
+from mpi_cuda_largescaleknn_tpu.parallel.mesh import (
+    AXIS,
+    get_mesh,
+    initialize_distributed,
+)
+from mpi_cuda_largescaleknn_tpu.parallel.ring import ring_knn
+
+
+def run_unordered_multihost(cfg: KnnConfig, in_path: str, out_path: str,
+                            extras: dict) -> int:
+    import jax
+    from jax.experimental import multihost_utils
+
+    for flag in ("write_indices", "checkpoint_dir"):
+        if extras.get(flag):
+            raise ValueError(f"--{flag.replace('_', '-')} is not supported "
+                             "in multi-host mode")
+    if extras.get("selfcheck") or cfg.query_chunk:
+        raise ValueError("--selfcheck/--query-chunk are not supported in "
+                         "multi-host mode")
+
+    initialize_distributed(extras["coordinator"], extras["num_hosts"],
+                           extras["host_id"])
+    mesh = get_mesh(extras["shards"])
+    num_shards = mesh.shape[AXIS]
+    proc = jax.process_index()
+
+    n_total = os.path.getsize(in_path) // 12
+    bounds = slab_bounds(n_total, num_shards)
+    npad = max(e - b for b, e in bounds)
+
+    # mesh positions whose devices this process hosts (ascending, so the
+    # concatenated local block matches global index order)
+    mesh_devs = list(mesh.devices.ravel())
+    my_pos = [i for i, d in enumerate(mesh_devs) if d.process_index == proc]
+    assert my_pos == sorted(my_pos)
+
+    shards = []
+    for s in my_pos:
+        pts, begin, _ = read_file_portion(in_path, s, num_shards)
+        assert begin == bounds[s][0]
+        shards.append(pts)
+    local_flat, local_ids, counts, _ = pad_and_flatten(
+        shards, id_bases=[bounds[s][0] for s in my_pos], pad_to=npad)
+    print(f"# host {proc}: mesh of {num_shards} device(s), "
+          f"{sum(counts)} of {n_total} points local")
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    sharding = NamedSharding(mesh, P(AXIS))
+    flat_g = jax.make_array_from_process_local_data(
+        sharding, local_flat, (num_shards * npad, 3))
+    ids_g = jax.make_array_from_process_local_data(
+        sharding, local_ids, (num_shards * npad,))
+
+    dists = ring_knn(flat_g, ids_g, cfg.k, mesh, max_radius=cfg.max_radius,
+                     engine=cfg.engine, query_tile=cfg.query_tile,
+                     point_tile=cfg.point_tile, bucket_size=cfg.bucket_size)
+
+    # host 0 pre-sizes the single global output file (stale-bytes safety,
+    # io/native_io.cpp lsk_create_sized), a sync fences it before the
+    # concurrent slab writers — then each host writes ONLY its slabs
+    if proc == 0:
+        write_distances_slab(out_path, 0, np.empty((0,), np.float32),
+                             n_total, presize=True)
+    multihost_utils.sync_global_devices("lsk_output_presized")
+    local_rows = {int(sh.index[0].start) // npad:
+                  np.asarray(sh.data).reshape(-1)
+                  for sh in dists.addressable_shards}
+    for s, cnt in zip(my_pos, counts):
+        write_distances_slab(out_path, bounds[s][0],
+                             local_rows[s][:cnt], n_total)
+    multihost_utils.sync_global_devices("lsk_output_written")
+    print("done all queries...")
+    return 0
+
+
+def run_prepartitioned_multihost(cfg: KnnConfig, in_path: str,
+                                 out_prefix: str, extras: dict) -> int:
+    """Pod-scale prepartitioned pipeline: one partition file per mesh
+    position (the reference's one-file-per-rank, asserted at
+    prePartitionedDataVariant.cu:215-216); each host reads ONLY the files
+    of its local positions. The global pad-to-max (:251-266) needs every
+    partition's count — obtained from file sizes (metadata stat, no data
+    read), the ``Allreduce(MAX)`` of :254-255 done on the filesystem."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    from mpi_cuda_largescaleknn_tpu.io.reader import (
+        read_list_of_file_names,
+        read_points,
+    )
+    from mpi_cuda_largescaleknn_tpu.io.writer import write_rank_file
+    from mpi_cuda_largescaleknn_tpu.parallel.demand import demand_knn
+
+    for flag in ("write_indices", "checkpoint_dir"):
+        if extras.get(flag):
+            raise ValueError(f"--{flag.replace('_', '-')} is not supported "
+                             "in multi-host mode")
+    if extras.get("selfcheck"):
+        raise ValueError("--selfcheck is not supported in multi-host mode")
+
+    initialize_distributed(extras["coordinator"], extras["num_hosts"],
+                           extras["host_id"])
+    file_names = read_list_of_file_names(in_path)
+    mesh = get_mesh(extras["shards"] if extras["shards"] is not None
+                    else len(file_names))
+    num_shards = mesh.shape[AXIS]
+    if len(file_names) != num_shards:
+        raise RuntimeError("number of input files does not match mesh size")
+    proc = jax.process_index()
+
+    sizes = [os.path.getsize(f) // 12 for f in file_names]
+    npad = max(max(sizes), 1)
+    id_bases = np.concatenate([[0], np.cumsum(sizes)[:-1]]).tolist()
+
+    mesh_devs = list(mesh.devices.ravel())
+    my_pos = [i for i, d in enumerate(mesh_devs) if d.process_index == proc]
+    parts = [read_points(file_names[s]) for s in my_pos]
+    for s, p in zip(my_pos, parts):
+        assert len(p) == sizes[s], (file_names[s], len(p), sizes[s])
+        print(f"#{s}/{num_shards}: got {len(p)} points to work on")
+    local_flat, local_ids, counts, _ = pad_and_flatten(
+        parts, id_bases=[id_bases[s] for s in my_pos], pad_to=npad)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    sharding = NamedSharding(mesh, P(AXIS))
+    flat_g = jax.make_array_from_process_local_data(
+        sharding, local_flat, (num_shards * npad, 3))
+    ids_g = jax.make_array_from_process_local_data(
+        sharding, local_ids, (num_shards * npad,))
+
+    dists = demand_knn(flat_g, ids_g, cfg.k, mesh,
+                       max_radius=cfg.max_radius, engine=cfg.engine,
+                       query_tile=cfg.query_tile, point_tile=cfg.point_tile,
+                       bucket_size=cfg.bucket_size)
+
+    local_rows = {int(sh.index[0].start) // npad:
+                  np.asarray(sh.data).reshape(-1)
+                  for sh in dists.addressable_shards}
+    for s, cnt in zip(my_pos, counts):
+        write_rank_file(out_prefix, s, local_rows[s][:cnt])
+    multihost_utils.sync_global_devices("lsk_prepart_written")
+    print("done all queries...")
+    return 0

@@ -30,6 +30,13 @@ def main(argv: list[str] | None = None) -> int:
     cfg, in_path, out_prefix, extras = parse_args(
         "tpuknn-prepartitioned", sys.argv[1:] if argv is None else argv)
 
+    if extras["num_hosts"] > 1:
+        # pod-scale SPMD launch: per-host file IO + one global mesh
+        from mpi_cuda_largescaleknn_tpu.cli.multihost import (
+            run_prepartitioned_multihost,
+        )
+        return run_prepartitioned_multihost(cfg, in_path, out_prefix, extras)
+
     file_names = read_list_of_file_names(in_path)
     mesh = get_mesh(extras["shards"] if extras["shards"] is not None
                     else len(file_names))

@@ -26,6 +26,14 @@ def main(argv: list[str] | None = None) -> int:
     cfg, in_path, out_path, extras = parse_args(
         "tpuknn-unordered", sys.argv[1:] if argv is None else argv)
 
+    if extras["num_hosts"] > 1:
+        # pod-scale SPMD launch: per-host slab IO + one global mesh
+        # (the reference's mpirun contract, see cli/multihost.py)
+        from mpi_cuda_largescaleknn_tpu.cli.multihost import (
+            run_unordered_multihost,
+        )
+        return run_unordered_multihost(cfg, in_path, out_path, extras)
+
     mesh = get_mesh(extras["shards"])
     points, _begin, n_total = read_file_portion(in_path, 0, 1)
     print(f"# mesh of {mesh.shape[AXIS]} device(s): "

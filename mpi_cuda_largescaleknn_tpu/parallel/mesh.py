@@ -75,10 +75,18 @@ def initialize_distributed(coordinator: str | None = None,
                            process_id: int | None = None) -> None:
     """Multi-host lifecycle init (no-op on a single host).
 
-    Mirrors ``MPI_Init`` in the reference; on TPU pods the runtime usually
-    autodetects everything, so explicit args are only needed off-TPU.
+    Mirrors ``MPI_Init`` in the reference (unorderedDataVariant.cu:107); on
+    TPU pods the runtime usually autodetects everything, so explicit args
+    are only needed off-TPU. On the CPU backend (the multi-node-without-a-
+    cluster fixture) cross-process collectives ride gloo.
     """
     if num_processes is not None and num_processes > 1:
+        if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+            try:
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
+            except Exception:
+                pass  # older jax: option absent, collectives still default
         jax.distributed.initialize(coordinator_address=coordinator,
                                    num_processes=num_processes,
                                    process_id=process_id)

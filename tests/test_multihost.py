@@ -1,0 +1,163 @@
+"""Multi-host execution: 2 cooperating CPU processes == 1 process, byte-for-byte.
+
+The TPU-world "pod without a pod" fixture: two OS processes, one CPU device
+each, joined into a single 2-device mesh by ``jax.distributed.initialize``
+(gloo collectives). The multi-host CLI path (cli/multihost.py) must produce
+the SAME bytes as the single-process CLI at the same shard count — the
+reference's rank-count-invariance oracle (SURVEY.md §4) applied across
+process boundaries.
+
+These tests spawn their own subprocesses with a clean CPU env (the outer
+pytest process stays off the TPU tunnel, tests/conftest.py).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _cpu_env(n_local_devices: int = 1) -> dict:
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if not f.startswith("--xla_force_host_platform_device_count")]
+    if n_local_devices > 1:
+        flags.append(
+            f"--xla_force_host_platform_device_count={n_local_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    return env
+
+
+@pytest.mark.parametrize("n,k", [(600, 5)])
+def test_two_process_matches_single_process(tmp_path, n, k):
+    rng = np.random.default_rng(3)
+    pts = rng.random((n, 3)).astype(np.float32)
+    in_path = str(tmp_path / "pts.float3")
+    pts.tofile(in_path)
+
+    # single process, 2 virtual devices -> reference output at R=2
+    single_out = str(tmp_path / "single.float")
+    r = subprocess.run(
+        [sys.executable, "-m", "mpi_cuda_largescaleknn_tpu.cli.unordered_main",
+         in_path, "-o", single_out, "-k", str(k), "--shards", "2",
+         "--bucket-size", "64"],
+        env=_cpu_env(2), capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+
+    # two processes, 1 device each, same R=2 mesh spanning both
+    multi_out = str(tmp_path / "multi.float")
+    port = _free_port()
+    base = [sys.executable, "-m",
+            "mpi_cuda_largescaleknn_tpu.cli.unordered_main",
+            in_path, "-o", multi_out, "-k", str(k), "--bucket-size", "64",
+            "--coordinator", f"127.0.0.1:{port}", "--num-hosts", "2"]
+    p1 = subprocess.Popen(base + ["--host-id", "1"], env=_cpu_env(),
+                          stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                          text=True)
+    p0 = subprocess.Popen(base + ["--host-id", "0"], env=_cpu_env(),
+                          stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                          text=True)
+    out0, err0 = p0.communicate(timeout=600)
+    out1, err1 = p1.communicate(timeout=600)
+    assert p0.returncode == 0, err0[-2000:]
+    assert p1.returncode == 0, err1[-2000:]
+
+    want = np.fromfile(single_out, np.float32)
+    got = np.fromfile(multi_out, np.float32)
+    assert want.shape == got.shape == (n,)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_two_process_prepartitioned_matches_single(tmp_path):
+    rng = np.random.default_rng(11)
+    n, k = 500, 4
+    pts = rng.random((n, 3)).astype(np.float32)
+    pts = pts[np.argsort(pts[:, 0], kind="stable")]
+    parts = [pts[:n // 2], pts[n // 2:]]
+    names = []
+    for i, p in enumerate(parts):
+        f = str(tmp_path / f"part{i}.float3")
+        p.tofile(f)
+        names.append(f)
+    flist = str(tmp_path / "files.txt")
+    with open(flist, "w") as f:
+        f.write("\n".join(names) + "\n")
+
+    r = subprocess.run(
+        [sys.executable, "-m",
+         "mpi_cuda_largescaleknn_tpu.cli.prepartitioned_main",
+         flist, "-o", str(tmp_path / "single"), "-k", str(k),
+         "--bucket-size", "64"],
+        env=_cpu_env(2), capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+
+    port = _free_port()
+    base = [sys.executable, "-m",
+            "mpi_cuda_largescaleknn_tpu.cli.prepartitioned_main",
+            flist, "-o", str(tmp_path / "multi"), "-k", str(k),
+            "--bucket-size", "64",
+            "--coordinator", f"127.0.0.1:{port}", "--num-hosts", "2"]
+    p1 = subprocess.Popen(base + ["--host-id", "1"], env=_cpu_env(),
+                          stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                          text=True)
+    p0 = subprocess.Popen(base + ["--host-id", "0"], env=_cpu_env(),
+                          stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                          text=True)
+    _, err0 = p0.communicate(timeout=600)
+    _, err1 = p1.communicate(timeout=600)
+    assert p0.returncode == 0, err0[-2000:]
+    assert p1.returncode == 0, err1[-2000:]
+
+    for i in range(2):
+        want = np.fromfile(str(tmp_path / f"single_{i:06d}.float"),
+                           np.float32)
+        got = np.fromfile(str(tmp_path / f"multi_{i:06d}.float"), np.float32)
+        np.testing.assert_array_equal(got, want)
+
+
+def test_multihost_presize_clears_stale_bytes(tmp_path):
+    """A stale longer output file from a prior run must not leak trailing
+    bytes into the new output (io/native_io.cpp lsk_create_sized)."""
+    rng = np.random.default_rng(5)
+    n, k = 300, 4
+    pts = rng.random((n, 3)).astype(np.float32)
+    in_path = str(tmp_path / "pts.float3")
+    pts.tofile(in_path)
+    out_path = str(tmp_path / "out.float")
+    np.full(4 * n, 7.0, np.float32).tofile(out_path)  # stale, 4x longer
+
+    port = _free_port()
+    base = [sys.executable, "-m",
+            "mpi_cuda_largescaleknn_tpu.cli.unordered_main",
+            in_path, "-o", out_path, "-k", str(k), "--bucket-size", "64",
+            "--coordinator", f"127.0.0.1:{port}", "--num-hosts", "2"]
+    p1 = subprocess.Popen(base + ["--host-id", "1"], env=_cpu_env(),
+                          stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                          text=True)
+    p0 = subprocess.Popen(base + ["--host-id", "0"], env=_cpu_env(),
+                          stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                          text=True)
+    _, err0 = p0.communicate(timeout=600)
+    _, err1 = p1.communicate(timeout=600)
+    assert p0.returncode == 0, err0[-2000:]
+    assert p1.returncode == 0, err1[-2000:]
+
+    got = np.fromfile(out_path, np.float32)
+    assert got.shape == (n,), "stale trailing bytes survived the rewrite"
+    assert np.all(np.isfinite(got)) and not np.any(got == 7.0)
