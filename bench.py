@@ -84,8 +84,9 @@ for n in ladder:
                 ring_s = model.timers.report().get("ring", {}).get("seconds")
         assert out.shape == (n,) and np.all(np.isfinite(out))
         from mpi_cuda_largescaleknn_tpu.obs.cost import cost_report
+        kind = getattr(devs[0], "device_kind", None)
         cr = cost_report((model.last_stats or {}).get("pair_evals", 0),
-                         ring_s or best, platform)
+                         ring_s or best, platform, kind)
         print("RESULT " + json.dumps({
             "n": n, "seconds": best, "compile_s": round(compile_s, 2),
             "device_seconds": ring_s,
@@ -94,7 +95,14 @@ for n in ladder:
         break
     except AssertionError:
         raise  # non-finite/bad-shape output is a correctness bug, not OOM
-    except Exception as e:  # OOM at this rung -> try the next size down
+    except Exception as e:  # resource exhaustion at this rung -> size down
+        low = f"{type(e).__name__}: {e}".lower()
+        is_resource = isinstance(e, MemoryError) or any(
+            t in low for t in ("resource_exhausted", "out of memory", "oom",
+                               "memoryerror", "failed to allocate",
+                               "allocation"))
+        if not is_resource:
+            raise  # a real bug must fail the bench, not shrink it
         print("FAILSIZE " + json.dumps(
             {"n": n, "error": f"{type(e).__name__}: {e}"[:400]}), flush=True)
 """

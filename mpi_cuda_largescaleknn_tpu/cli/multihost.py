@@ -43,18 +43,48 @@ from mpi_cuda_largescaleknn_tpu.parallel.mesh import (
 from mpi_cuda_largescaleknn_tpu.parallel.ring import ring_knn
 
 
+def _my_mesh_positions(mesh) -> list[int]:
+    """Mesh positions whose devices this process hosts (ascending, so the
+    concatenated local block matches global index order).
+
+    Validates — identically on EVERY host, before any collective — that each
+    launched process owns at least one mesh position. When --shards is
+    smaller than the pod's device count, ``get_mesh`` takes a device prefix
+    and can exclude every device of some process; that host would then feed
+    an empty block to ``make_array_from_process_local_data`` while the
+    others block forever inside the collective — a silent distributed hang.
+    Raising the same error everywhere turns it into a clean failure."""
+    import jax
+
+    mesh_devs = list(mesh.devices.ravel())
+    owners = {d.process_index for d in mesh_devs}
+    missing = sorted(set(range(jax.process_count())) - owners)
+    if missing:
+        raise RuntimeError(
+            f"mesh of {len(mesh_devs)} device(s) excludes all devices of "
+            f"process(es) {missing} of {jax.process_count()}; every launched "
+            "process must own at least one mesh position — increase --shards "
+            "(or the partition-file count) or launch fewer hosts")
+    my_pos = [i for i, d in enumerate(mesh_devs)
+              if d.process_index == jax.process_index()]
+    assert my_pos == sorted(my_pos)
+    return my_pos
+
+
 def run_unordered_multihost(cfg: KnnConfig, in_path: str, out_path: str,
                             extras: dict) -> int:
     import jax
     from jax.experimental import multihost_utils
 
-    for flag in ("write_indices", "checkpoint_dir"):
-        if extras.get(flag):
-            raise ValueError(f"--{flag.replace('_', '-')} is not supported "
-                             "in multi-host mode")
-    if extras.get("selfcheck") or cfg.query_chunk:
-        raise ValueError("--selfcheck/--query-chunk are not supported in "
+    if extras.get("write_indices"):
+        raise ValueError("--write-indices is not supported in "
                          "multi-host mode")
+    if extras.get("selfcheck"):
+        raise ValueError("--selfcheck is not supported in multi-host mode")
+    if cfg.checkpoint_dir and not cfg.query_chunk:
+        raise ValueError("multi-host --checkpoint-dir requires "
+                         "--query-chunk (per-chunk result checkpoints; "
+                         "round-level heap snapshots are single-host only)")
 
     initialize_distributed(extras["coordinator"], extras["num_hosts"],
                            extras["host_id"])
@@ -66,11 +96,7 @@ def run_unordered_multihost(cfg: KnnConfig, in_path: str, out_path: str,
     bounds = slab_bounds(n_total, num_shards)
     npad = max(e - b for b, e in bounds)
 
-    # mesh positions whose devices this process hosts (ascending, so the
-    # concatenated local block matches global index order)
-    mesh_devs = list(mesh.devices.ravel())
-    my_pos = [i for i, d in enumerate(mesh_devs) if d.process_index == proc]
-    assert my_pos == sorted(my_pos)
+    my_pos = _my_mesh_positions(mesh)
 
     shards = []
     for s in my_pos:
@@ -89,9 +115,27 @@ def run_unordered_multihost(cfg: KnnConfig, in_path: str, out_path: str,
     ids_g = jax.make_array_from_process_local_data(
         sharding, local_ids, (num_shards * npad,))
 
-    dists = ring_knn(flat_g, ids_g, cfg.k, mesh, max_radius=cfg.max_radius,
-                     engine=cfg.engine, query_tile=cfg.query_tile,
-                     point_tile=cfg.point_tile, bucket_size=cfg.bucket_size)
+    if cfg.query_chunk > 0:
+        # streamed query chunks (the beyond-HBM heap regime) composed with
+        # the pod-scale path: each host chunks its own blocks, optionally
+        # checkpointing its rows per chunk (parallel/ring.py multi branch)
+        from mpi_cuda_largescaleknn_tpu.parallel.ring import ring_knn_chunked
+
+        local_rows = ring_knn_chunked(
+            flat_g, ids_g, cfg.k, mesh, chunk_rows=cfg.query_chunk,
+            max_radius=cfg.max_radius, engine=cfg.engine,
+            query_tile=cfg.query_tile, point_tile=cfg.point_tile,
+            bucket_size=cfg.bucket_size, checkpoint_dir=cfg.checkpoint_dir,
+            checkpoint_every=cfg.checkpoint_every)
+    else:
+        dists = ring_knn(flat_g, ids_g, cfg.k, mesh,
+                         max_radius=cfg.max_radius, engine=cfg.engine,
+                         query_tile=cfg.query_tile,
+                         point_tile=cfg.point_tile,
+                         bucket_size=cfg.bucket_size)
+        local_rows = {int(sh.index[0].start) // npad:
+                      np.asarray(sh.data).reshape(-1)
+                      for sh in dists.addressable_shards}
 
     # host 0 pre-sizes the single global output file (stale-bytes safety,
     # io/native_io.cpp lsk_create_sized), a sync fences it before the
@@ -100,9 +144,6 @@ def run_unordered_multihost(cfg: KnnConfig, in_path: str, out_path: str,
         write_distances_slab(out_path, 0, np.empty((0,), np.float32),
                              n_total, presize=True)
     multihost_utils.sync_global_devices("lsk_output_presized")
-    local_rows = {int(sh.index[0].start) // npad:
-                  np.asarray(sh.data).reshape(-1)
-                  for sh in dists.addressable_shards}
     for s, cnt in zip(my_pos, counts):
         write_distances_slab(out_path, bounds[s][0],
                              local_rows[s][:cnt], n_total)
@@ -150,8 +191,7 @@ def run_prepartitioned_multihost(cfg: KnnConfig, in_path: str,
     npad = max(max(sizes), 1)
     id_bases = np.concatenate([[0], np.cumsum(sizes)[:-1]]).tolist()
 
-    mesh_devs = list(mesh.devices.ravel())
-    my_pos = [i for i, d in enumerate(mesh_devs) if d.process_index == proc]
+    my_pos = _my_mesh_positions(mesh)
     parts = [read_points(file_names[s]) for s in my_pos]
     for s, p in zip(my_pos, parts):
         assert len(p) == sizes[s], (file_names[s], len(p), sizes[s])

@@ -56,7 +56,19 @@ def main(argv: list[str] | None = None) -> int:
         print(f"selfcheck OK ({checked} samples)")
     print("done all queries...")
     if extras["timings"]:
-        sys.stderr.write(model.timers.dump() + "\n")
+        import json
+
+        from mpi_cuda_largescaleknn_tpu.models.sharding import slab_bounds
+        from mpi_cuda_largescaleknn_tpu.parallel.ring import (
+            measure_exchange_bandwidth,
+        )
+        report = model.timers.report()
+        num_shards = mesh.shape[AXIS]
+        if num_shards > 1:
+            npad = max(e - b for b, e in slab_bounds(n_total, num_shards))
+            report["exchange"] = measure_exchange_bandwidth(
+                mesh, npad, bucket_size=cfg.bucket_size, engine=cfg.engine)
+        sys.stderr.write(json.dumps(report) + "\n")
     return 0
 
 

@@ -35,11 +35,14 @@ def read_file_portion(path: str, rank: int, size: int):
     num_data = num_bytes // _RECORD_BYTES
     begin = num_data * rank // size
     end = num_data * (rank + 1) // size
-    try:
-        from mpi_cuda_largescaleknn_tpu.io.native import native_read_slab
+    from mpi_cuda_largescaleknn_tpu.io import native
 
-        pts = native_read_slab(path, begin, end - begin)
-    except Exception:
+    if native.available():
+        # a native read that RUNS and fails (short read, IO error) raises —
+        # silently re-reading with numpy would mask real corruption; numpy
+        # is the fallback only when the library cannot be built at all
+        pts = native.native_read_slab(path, begin, end - begin)
+    else:
         with open(path, "rb") as f:
             f.seek(begin * _RECORD_BYTES)
             pts = np.fromfile(f, dtype=np.float32, count=(end - begin) * 3)

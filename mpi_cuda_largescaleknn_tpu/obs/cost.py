@@ -14,9 +14,11 @@ peak, not the headline MXU number. The candidate-row merge (sorts,
 compares) is real additional work not counted here: the estimate is a
 LOWER bound on achieved utilization.
 
-Per-chip vector-peak assumptions are order-of-magnitude from public specs
-and overridable with ``LSK_PEAK_FLOPS`` (f32 FLOP/s); every report carries
-the assumed peak so nothing is presented as more precise than it is.
+Per-chip vector-peak assumptions are derived from the PROBED device kind
+(``jax.devices()[0].device_kind``) using public per-generation VPU shapes
+(lanes x sublanes x ALUs x clock), and overridable with ``LSK_PEAK_FLOPS``
+(f32 FLOP/s); every report carries the assumed peak and the chip kind so
+nothing is presented as more precise than it is.
 """
 
 from __future__ import annotations
@@ -25,28 +27,48 @@ import os
 
 FLOPS_PER_PAIR = 8  # 3 sub + 3 mul + 2 add per 3-D squared distance
 
-# assumed peak VECTOR f32 FLOP/s per chip (see module docstring)
+# peak VECTOR f32 FLOP/s by device-kind substring (first match wins).
+# VPU = 8 sublanes x 128 lanes x 4 ALUs x clock: v5e ~0.94 GHz -> 3.85e12,
+# v4 ~1.05 GHz -> 4.3e12, v5p ~1.75 GHz -> 7.2e12; v6e wider -> ~8e12.
+_PEAK_BY_KIND = (
+    ("v5 lite", 3.85e12),
+    ("v5e", 3.85e12),
+    ("v5p", 7.2e12),
+    ("v5", 7.2e12),   # bare "TPU v5" spelling = v5p (jax tpu_info)
+    ("v6", 8.0e12),
+    ("v4", 4.3e12),
+    ("v3", 1.6e12),
+)
+
+# platform-level fallback when no device kind is known
 _PEAK_VPU_F32 = {
     "tpu": 4.0e12,   # TPU v4/v5-class VPU order of magnitude
     "cpu": 1.0e11,   # one AVX-ish host core pool, for labeled fallbacks
 }
 
 
-def peak_flops(platform: str) -> float:
+def peak_flops(platform: str, device_kind: str | None = None) -> float:
     env = os.environ.get("LSK_PEAK_FLOPS")
     if env:
         return float(env)
+    if device_kind:
+        low = device_kind.lower()
+        for frag, peak in _PEAK_BY_KIND:
+            if frag in low:
+                return peak
     return _PEAK_VPU_F32.get(platform, _PEAK_VPU_F32["tpu"])
 
 
-def cost_report(pair_evals: int, seconds: float, platform: str) -> dict:
+def cost_report(pair_evals: int, seconds: float, platform: str,
+                device_kind: str | None = None) -> dict:
     """{device flop estimate, pair-eval throughput, MFU vs vector peak}."""
     flops = pair_evals * FLOPS_PER_PAIR
-    peak = peak_flops(platform)
+    peak = peak_flops(platform, device_kind)
     return {
         "pair_evals": int(pair_evals),
         "pair_evals_per_sec": round(pair_evals / seconds, 1) if seconds else 0.0,
         "distance_flops": int(flops),
         "assumed_peak_flops": peak,
+        "device_kind": device_kind or platform,
         "mfu_estimate": round(flops / seconds / peak, 4) if seconds else 0.0,
     }

@@ -46,20 +46,25 @@ def fold_tile_into_candidates(d2, ids_row, cand_d2, cand_idx):
     cols = jax.lax.broadcasted_iota(jnp.int32, (s, k), 1)
     ids_b = jnp.broadcast_to(ids_row, (s, t))
 
+    def kth(cd2):
+        # static slice, NOT cd2[:, -1]: integer indexing lowers to
+        # dynamic_slice, which Mosaic's TPU lowering rejects
+        return jax.lax.slice_in_dim(cd2, k - 1, k, axis=1)      # [S, 1]
+
     def cond(carry):
         return carry[0]
 
     def body(carry):
         _, d2, cd2, cidx = carry
         m = jnp.min(d2, axis=1)                       # [S]
-        improved = m < cd2[:, -1]
+        improved = m[:, None] < kth(cd2)              # [S, 1]
         # first lane holding the row minimum
         is_min = d2 == m[:, None]
         ml = jnp.min(jnp.where(is_min, lane, t), axis=1)
         sel = is_min & (lane == ml[:, None])
         mid = jnp.max(jnp.where(sel, ids_b, _NEG_BIG), axis=1)
         # consume the extracted lane
-        d2 = jnp.where(sel & improved[:, None], jnp.inf, d2)
+        d2 = jnp.where(sel & improved, jnp.inf, d2)
 
         # sorted insert: after any equal entries (stable, existing first);
         # right-shift by one (the shifted col 0 is never selected: col > pos
@@ -72,12 +77,12 @@ def fold_tile_into_candidates(d2, ids_row, cand_d2, cand_idx):
         ins_idx = jnp.where(cols < pos[:, None], cidx,
                             jnp.where(cols == pos[:, None], mid[:, None],
                                       roll_idx))
-        cd2 = jnp.where(improved[:, None], ins_d2, cd2)
-        cidx = jnp.where(improved[:, None], ins_idx, cidx)
-        go = jnp.any(jnp.min(d2, axis=1) < cd2[:, -1])
+        cd2 = jnp.where(improved, ins_d2, cd2)
+        cidx = jnp.where(improved, ins_idx, cidx)
+        go = jnp.any(jnp.min(d2, axis=1)[:, None] < kth(cd2))
         return go, d2, cd2, cidx
 
-    go0 = jnp.any(jnp.min(d2, axis=1) < cand_d2[:, -1])
+    go0 = jnp.any(jnp.min(d2, axis=1)[:, None] < kth(cand_d2))
     _, _, cand_d2, cand_idx = jax.lax.while_loop(
         cond, body, (go0, d2, cand_d2, cand_idx))
     return cand_d2, cand_idx

@@ -46,16 +46,19 @@ from mpi_cuda_largescaleknn_tpu.ops.partition import (
 )
 
 
-def _kernel(order_ref, boxd2_ref,            # SMEM: [1, Bp] i32 / f32
-            q_ref, qid_ref,                  # VMEM: [1, S, 3] / [1, S]
+def _kernel(order_ref, boxd2_ref,            # SMEM: [1, 1, Bp] i32 / f32
+            q_ref, qid_ref,                  # VMEM: [1, S, 3] / [1, S, 1]
             in_d2_ref, in_idx_ref,           # VMEM: [S, k]
             p_hbm, pid_hbm,                  # ANY (HBM): [Bp, 3, T] / [Bp, 1, T]
             out_d2_ref, out_idx_ref,         # VMEM: [S, k]
-            vis_ref,                         # SMEM: [1, 1] i32 visits
+            vis_ref,                         # SMEM: [1, 1, 1] i32 visits
             p_buf, id_buf, sems):            # scratch: [2,3,T], [2,1,T], (2,2)
     num_pb = p_hbm.shape[0]
+    kk = in_d2_ref.shape[-1]
     q = q_ref[0]                             # [S, 3]
-    qvalid = qid_ref[0, :] >= 0              # [S]
+    # [S, 1] column layout so the bool mask never needs a minor-dim
+    # insertion (Mosaic supports those only for 32-bit types)
+    qvalid = qid_ref[0] >= 0                 # [S, 1]
 
     def dma_pts(slot, visit):
         return pltpu.make_async_copy(p_hbm.at[visit], p_buf.at[slot],
@@ -66,17 +69,20 @@ def _kernel(order_ref, boxd2_ref,            # SMEM: [1, Bp] i32 / f32
                                      sems.at[slot, 1])
 
     def start(slot, s):
-        visit = order_ref[0, s]
+        visit = order_ref[0, 0, s]
         dma_pts(slot, visit).start()
         dma_ids(slot, visit).start()
 
     def wait(slot, s):
-        visit = order_ref[0, s]
+        visit = order_ref[0, 0, s]
         dma_pts(slot, visit).wait()
         dma_ids(slot, visit).wait()
 
     def worst2(cd2):
-        return jnp.max(jnp.where(qvalid, cd2[:, -1], -jnp.inf))
+        # static slice, NOT cd2[:, -1]: integer indexing lowers to
+        # dynamic_slice, which Mosaic's TPU lowering rejects
+        cd2_kth = lax.slice_in_dim(cd2, kk - 1, kk, axis=1)   # [S, 1]
+        return jnp.max(jnp.where(qvalid, cd2_kth, -jnp.inf))
 
     start(0, 0)
 
@@ -85,7 +91,7 @@ def _kernel(order_ref, boxd2_ref,            # SMEM: [1, Bp] i32 / f32
         # & does not short-circuit in traced code: clamp the index so the
         # final evaluation at s == num_pb stays in bounds (cf. ops/tiled.py)
         s_safe = jnp.minimum(s, num_pb - 1)
-        return (s < num_pb) & (boxd2_ref[0, s_safe] < worst2(cd2))
+        return (s < num_pb) & (boxd2_ref[0, 0, s_safe] < worst2(cd2))
 
     def body(carry):
         s, cd2, cidx = carry
@@ -117,12 +123,12 @@ def _kernel(order_ref, boxd2_ref,            # SMEM: [1, Bp] i32 / f32
 
     out_d2_ref[:] = cd2
     out_idx_ref[:] = cidx
-    vis_ref[0, 0] = s_exit  # buckets this query bucket actually scored
+    vis_ref[0, 0, 0] = s_exit  # buckets this query bucket actually scored
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _run(order, boxd2, q_pts, q_ids, in_d2, in_idx, p_t, pid_t, *, interpret):
-    num_qb, s_q = q_ids.shape
+    num_qb, s_q, _one = q_ids.shape
     num_pb, _, t_p = p_t.shape
     k = in_d2.shape[-1]
     grid = (num_qb,)
@@ -130,13 +136,16 @@ def _run(order, boxd2, q_pts, q_ids, in_d2, in_idx, p_t, pid_t, *, interpret):
         _kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, num_pb), lambda b: (b, 0),
+            # Mosaic requires the LAST TWO block dims to be sublane/lane
+            # aligned or equal to the array dims; a middle singleton makes
+            # per-bucket rows of the SMEM schedule arrays legal blocks
+            pl.BlockSpec((1, 1, num_pb), lambda b: (b, 0, 0),
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, num_pb), lambda b: (b, 0),
+            pl.BlockSpec((1, 1, num_pb), lambda b: (b, 0, 0),
                          memory_space=pltpu.SMEM),
             pl.BlockSpec((1, s_q, 3), lambda b: (b, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, s_q), lambda b: (b, 0),
+            pl.BlockSpec((1, s_q, 1), lambda b: (b, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((s_q, k), lambda b: (b, 0),
                          memory_space=pltpu.VMEM),
@@ -150,7 +159,7 @@ def _run(order, boxd2, q_pts, q_ids, in_d2, in_idx, p_t, pid_t, *, interpret):
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((s_q, k), lambda b: (b, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1), lambda b: (b, 0),
+            pl.BlockSpec((1, 1, 1), lambda b: (b, 0, 0),
                          memory_space=pltpu.SMEM),
         ),
         out_shape=(
@@ -162,7 +171,7 @@ def _run(order, boxd2, q_pts, q_ids, in_d2, in_idx, p_t, pid_t, *, interpret):
             jax.ShapeDtypeStruct((num_qb * s_q, k), jnp.int32,
                                  vma=getattr(jax.typeof(in_idx), "vma",
                                              frozenset())),
-            jax.ShapeDtypeStruct((num_qb, 1), jnp.int32,
+            jax.ShapeDtypeStruct((num_qb, 1, 1), jnp.int32,
                                  vma=getattr(jax.typeof(in_idx), "vma",
                                              frozenset())),
         ),
@@ -201,7 +210,8 @@ def knn_update_tiled_pallas(state: CandidateState, q: BucketedPoints,
 
     assert state.dist2.shape == (num_qb * s_q, k), (state.dist2.shape,
                                                     (num_qb, s_q, k))
-    out_d2, out_idx, visits = _run(order, sorted_d2, q.pts, q.ids,
+    out_d2, out_idx, visits = _run(order[:, None, :], sorted_d2[:, None, :],
+                                   q.pts, q.ids[:, :, None],
                                    state.dist2, state.idx, p_t, pid_t,
                                    interpret=interpret)
     out = CandidateState(out_d2, out_idx)

@@ -42,9 +42,12 @@ from mpi_cuda_largescaleknn_tpu.ops.partition import (
 
 
 def _default_chunk(num_buckets: int, s: int, t: int,
-                   budget_elems: int = 4_000_000) -> int:
-    """Power-of-two query-bucket chunk keeping the [C, S, T] distance tile
-    within ~``budget_elems`` f32 elements (bounds peak VMEM/HBM traffic)."""
+                   budget_elems: int = 32_000_000) -> int:
+    """Power-of-two query-bucket chunk keeping the [C, S, V*T] distance tile
+    within ~``budget_elems`` f32 elements (~128 MB — bounds peak HBM
+    traffic while keeping the sequential ``lax.map`` short: the round-3
+    bench proved thousands of small serialized ops, not arithmetic, were
+    the bottleneck)."""
     c = max(1, budget_elems // max(s * t, 1))
     c = 1 << int(math.log2(c))
     return max(1, min(num_buckets, c))
@@ -61,7 +64,7 @@ def _worst2(hd2: jnp.ndarray, qvalid: jnp.ndarray) -> jnp.ndarray:
 
 def knn_update_tiled(state: CandidateState, q: BucketedPoints,
                      p: BucketedPoints, *, chunk_buckets: int | None = None,
-                     with_stats: bool = False):
+                     visits_per_step: int = 8, with_stats: bool = False):
     """Fold every real point of ``p`` into the candidate state (one
     reference ``runQuery`` launch, at bucket granularity).
 
@@ -70,17 +73,37 @@ def knn_update_tiled(state: CandidateState, q: BucketedPoints,
     ``with_stats`` also an i32 count of [S, T] distance tiles actually
     computed (chunks skipped by the all-pruned ``lax.cond`` don't count),
     from which callers derive executed distance evaluations / FLOPs.
+
+    Each ``while_loop`` step visits ``visits_per_step`` point buckets per
+    query bucket at once: one [C, S, V*T] distance tile and ONE width-2k
+    merge per chunk instead of V of each. The per-(bucket, visit) prune
+    mask keeps exactness — a bucket whose box distance is already beyond
+    the query bucket's worst k-th candidate contributes only +inf rows.
+    Round 3 proved the twin's bottleneck was thousands of small serialized
+    ops, not arithmetic; V-batching plus the wider chunk budget cuts the
+    sequential-op count by ~V * (new_budget / old_budget).
     """
     num_qb, s_q = q.ids.shape
     num_pb, s_p = p.ids.shape
     k = state.dist2.shape[-1]
 
-    chunk = chunk_buckets or _default_chunk(num_qb, s_q, s_p)
+    v = max(1, min(visits_per_step, num_pb))
+    chunk = chunk_buckets or _default_chunk(num_qb, s_q, s_p * v)
     assert num_qb % chunk == 0, (num_qb, chunk)
     n_chunks = num_qb // chunk
 
     sorted_d2, order = nearest_first_order(q.lower, q.upper,
                                            p.lower, p.upper)  # [Bq, Bp] x2
+    # pad the schedule to a multiple of V: padded visits carry +inf box
+    # distance (never active) and a valid dummy index
+    n_steps = -(-num_pb // v)
+    pad_v = n_steps * v - num_pb
+    if pad_v:
+        sorted_d2 = jnp.concatenate(
+            [sorted_d2, jnp.full((num_qb, pad_v), jnp.inf, sorted_d2.dtype)],
+            axis=1)
+        order = jnp.concatenate(
+            [order, jnp.zeros((num_qb, pad_v), order.dtype)], axis=1)
 
     qvalid = q.ids >= 0
     hd2 = state.dist2.reshape(num_qb, s_q, k)
@@ -91,34 +114,38 @@ def knn_update_tiled(state: CandidateState, q: BucketedPoints,
     def cond(carry):
         _hd2, _hidx, worst2, step, _tiles = carry
         next_d2 = lax.dynamic_index_in_dim(sorted_d2, jnp.minimum(
-            step, num_pb - 1), axis=1, keepdims=False)
-        return (step < num_pb) & jnp.any(next_d2 < worst2)
+            step * v, num_pb - 1), axis=1, keepdims=False)
+        return (step < n_steps) & jnp.any(next_d2 < worst2)
 
     def body(carry):
         hd2, hidx, worst2, step, tiles = carry
-        visit = lax.dynamic_index_in_dim(order, step, axis=1, keepdims=False)
-        visit_d2 = lax.dynamic_index_in_dim(sorted_d2, step, axis=1,
-                                            keepdims=False)
-        active = visit_d2 < worst2                                  # [Bq]
-        pts_v = p.pts[visit]                                        # [Bq,T,3]
-        ids_v = p.ids[visit]                                        # [Bq,T]
+        visit = lax.dynamic_slice_in_dim(order, step * v, v, axis=1)
+        visit_d2 = lax.dynamic_slice_in_dim(sorted_d2, step * v, v, axis=1)
+        active = visit_d2 < worst2[:, None]                      # [Bq, V]
+        pts_v = p.pts[visit]                                     # [Bq,V,T,3]
+        ids_v = p.ids[visit]                                     # [Bq,V,T]
 
         def chunk_fn(args):
             qp, pp, pid, act, cd2, cidx = args
 
             def compute(_):
-                dx = qp[:, :, None, 0] - pp[:, None, :, 0]
-                dy = qp[:, :, None, 1] - pp[:, None, :, 1]
-                dz = qp[:, :, None, 2] - pp[:, None, :, 2]
-                d2 = (dx * dx + dy * dy) + dz * dz                  # [C,S,T]
-                d2 = jnp.where(act[:, None, None], d2, jnp.inf)
+                # [C, S, V*T] distance tile against the V gathered buckets
+                ppf = pp.reshape(chunk, v * s_p, 3)
+                dx = qp[:, :, None, 0] - ppf[:, None, :, 0]
+                dy = qp[:, :, None, 1] - ppf[:, None, :, 1]
+                dz = qp[:, :, None, 2] - ppf[:, None, :, 2]
+                d2 = (dx * dx + dy * dy) + dz * dz
+                mask = jnp.broadcast_to(act[:, None, :, None],
+                                        (chunk, 1, v, s_p))
+                d2 = jnp.where(mask.reshape(chunk, 1, v * s_p), d2, jnp.inf)
                 st = merge_candidates(
                     CandidateState(cd2.reshape(chunk * s_q, k),
                                    cidx.reshape(chunk * s_q, k)),
-                    d2.reshape(chunk * s_q, s_p),
-                    jnp.broadcast_to(pid[:, None, :, ...],
-                                     (chunk, s_q, s_p)).reshape(
-                                         chunk * s_q, s_p))
+                    d2.reshape(chunk * s_q, v * s_p),
+                    jnp.broadcast_to(
+                        pid.reshape(chunk, 1, v * s_p),
+                        (chunk, s_q, v * s_p)).reshape(
+                            chunk * s_q, v * s_p))
                 return (st.dist2.reshape(chunk, s_q, k),
                         st.idx.reshape(chunk, s_q, k))
 
@@ -131,20 +158,20 @@ def knn_update_tiled(state: CandidateState, q: BucketedPoints,
 
         hd2, hidx = lax.map(chunk_fn, (
             q_chunked,
-            pts_v.reshape(n_chunks, chunk, s_p, 3),
-            ids_v.reshape(n_chunks, chunk, s_p),
-            active.reshape(n_chunks, chunk),
+            pts_v.reshape(n_chunks, chunk, v, s_p, 3),
+            ids_v.reshape(n_chunks, chunk, v, s_p),
+            active.reshape(n_chunks, chunk, v),
             hd2.reshape(n_chunks, chunk, s_q, k),
             hidx.reshape(n_chunks, chunk, s_q, k)))
         hd2 = hd2.reshape(num_qb, s_q, k)
         hidx = hidx.reshape(num_qb, s_q, k)
         # tiles executed this step: skipped chunks contribute 0, a computed
-        # chunk contributes its full `chunk` buckets (masked-out buckets in
+        # chunk contributes its full chunk*V tiles (masked-out buckets in
         # an active chunk still burn VPU work — count what ran, not what
         # was useful)
-        act_c = active.reshape(n_chunks, chunk)
+        act_c = active.reshape(n_chunks, chunk * v)
         tiles = tiles + jnp.sum(
-            jnp.where(jnp.any(act_c, axis=1), chunk, 0)).astype(jnp.int32)
+            jnp.where(jnp.any(act_c, axis=1), chunk * v, 0)).astype(jnp.int32)
         return hd2, hidx, _worst2(hd2, qvalid), step + 1, tiles
 
     # derive the zero from the heap so the counter carries the same

@@ -67,6 +67,7 @@ from mpi_cuda_largescaleknn_tpu.parallel.mesh import AXIS, pvary
 from mpi_cuda_largescaleknn_tpu.parallel.ring import (
     _engine_fn,
     _tiled_engine_fn,
+    resolve_engine,
 )
 
 
@@ -199,6 +200,7 @@ def demand_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
     per-device count of query kernels actually run — the observability the
     reference only exposes as per-round stdout prints (:306).
     """
+    engine = resolve_engine(engine)
     num_shards = mesh.shape[AXIS]
     npad = points_sharded.shape[0] // num_shards
     init_fn, round_fn, final_fn = _make_demand_fns(
@@ -270,6 +272,7 @@ def demand_knn_stepwise(points_sharded: jnp.ndarray,
     """
     from mpi_cuda_largescaleknn_tpu.utils import checkpoint as ckpt
 
+    engine = resolve_engine(engine)
     num_shards = mesh.shape[AXIS]
     npad = points_sharded.shape[0] // num_shards
     init_fn, round_fn, final_fn = _make_demand_fns(

@@ -53,7 +53,12 @@ def test_resume_from_partial_checkpoint(tmp_path):
     # interrupted run: only 3 of 8 rounds execute before the "crash"
     partial = ring_knn_stepwise(flat, ids, 5, mesh, bucket_size=16,
                                 checkpoint_dir=cdir, max_rounds=3)
-    fp = ckpt.fingerprint(n=int(flat.shape[0]), k=5, shards=8, engine="auto",
+    from mpi_cuda_largescaleknn_tpu.parallel.ring import resolve_engine
+
+    # fingerprints record the RESOLVED engine (what actually computed the
+    # heaps), not the "auto" alias
+    fp = ckpt.fingerprint(n=int(flat.shape[0]), k=5, shards=8,
+                          engine=resolve_engine("auto"),
                           max_radius=float(np.inf), bucket_size=16,
                           query_tile=2048, point_tile=2048,
                           data=ckpt.data_digest(flat, ids))

@@ -1,0 +1,106 @@
+"""Dev harness: compile candidate kernel fragments under Mosaic to locate
+unsupported ops. Run on the real chip:  python tools/mosaic_bisect.py
+"""
+import functools
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+S, T, K = 256, 512, 8
+_NEG_BIG = -(2**31) + 1
+
+
+def run_case(name, body):
+    def kernel(x_ref, o_ref):
+        o_ref[:] = body(x_ref[:])
+
+    t0 = time.time()
+    try:
+        x = jnp.arange(S * T, dtype=jnp.float32).reshape(S, T) % 37.0
+        out = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((S, K), jnp.float32),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        )(x)
+        out.block_until_ready()
+        print(json.dumps({name: "ok", "s": round(time.time() - t0, 1)}),
+              flush=True)
+    except Exception as e:  # noqa: BLE001
+        print(json.dumps({name: f"{type(e).__name__}: {e}"[:300],
+                          "s": round(time.time() - t0, 1)}), flush=True)
+
+
+def case_min(d2):
+    m = jnp.min(d2, axis=1)
+    return jnp.broadcast_to(m[:, None], (S, K))
+
+
+def case_lane_extract(d2):
+    lane = jax.lax.broadcasted_iota(jnp.int32, (S, T), 1)
+    m = jnp.min(d2, axis=1)
+    is_min = d2 == m[:, None]
+    ml = jnp.min(jnp.where(is_min, lane, T), axis=1)
+    sel = is_min & (lane == ml[:, None])
+    mid = jnp.max(jnp.where(sel, lane, _NEG_BIG), axis=1)
+    return jnp.broadcast_to(mid[:, None].astype(jnp.float32), (S, K))
+
+
+def case_roll_concat(d2):
+    cd2 = d2[:, :K]
+    roll = jnp.concatenate([cd2[:, :1], cd2[:, :-1]], axis=1)
+    return roll
+
+
+def case_insert(d2):
+    cd2 = d2[:, :K]
+    m = jnp.min(d2, axis=1)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (S, K), 1)
+    pos = jnp.sum((cd2 <= m[:, None]).astype(jnp.int32), axis=1)
+    roll = jnp.concatenate([cd2[:, :1], cd2[:, :-1]], axis=1)
+    ins = jnp.where(cols < pos[:, None], cd2,
+                    jnp.where(cols == pos[:, None], m[:, None], roll))
+    return ins
+
+
+def case_while(d2):
+    def cond(c):
+        return c[0]
+
+    def body(c):
+        _, d2, cd2 = c
+        m = jnp.min(d2, axis=1)
+        improved = m < cd2[:, -1]
+        d2 = jnp.where((d2 == m[:, None]) & improved[:, None], jnp.inf, d2)
+        cd2 = jnp.where(improved[:, None], jnp.minimum(cd2, m[:, None]), cd2)
+        go = jnp.any(jnp.min(d2, axis=1) < cd2[:, -1])
+        return go, d2, cd2
+
+    cd2 = d2[:, :K] + 100.0
+    go0 = jnp.any(jnp.min(d2, axis=1) < cd2[:, -1])
+    _, _, cd2 = jax.lax.while_loop(cond, body, (go0, d2, cd2))
+    return cd2
+
+
+def case_full_fold(d2):
+    from mpi_cuda_largescaleknn_tpu.ops.pallas.knn_bf import (
+        fold_tile_into_candidates,
+    )
+    ids = jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
+    cd2 = jnp.full((S, K), jnp.inf, jnp.float32)
+    cidx = jnp.full((S, K), -1, jnp.int32)
+    cd2, cidx = fold_tile_into_candidates(d2, ids, cd2, cidx)
+    return cd2
+
+
+if __name__ == "__main__":
+    print(jax.devices(), flush=True)
+    for nm, fn in [("min", case_min), ("lane_extract", case_lane_extract),
+                   ("roll_concat", case_roll_concat), ("insert", case_insert),
+                   ("while", case_while), ("full_fold", case_full_fold)]:
+        run_case(nm, fn)
