@@ -66,11 +66,13 @@ else:
     outs = model.run(parts)
     dt = time.perf_counter() - t0
     assert sum(len(o) for o in outs) == n
-    # measured rounds-to-exit vs the theoretical optimum of the reference's
-    # nearest-first matching (prePartitionedDataVariant.cu:304-322): a rank
-    # needs peer j iff box_dist(box_i, box_j) < its worst k-th distance, so
-    # the best any schedule can do is 1 + max_i(#needed peers of i)
-    # (PARITY.md discusses the skip-ring vs nearest-first trade)
+    # measured rounds-to-exit vs schedule optima: a rank needs peer j iff
+    # box_dist(box_i, box_j) < its worst k-th distance. The reference's
+    # nearest-first matching (prePartitionedDataVariant.cu:304-322) moves
+    # one tree per rank per round -> best possible 1 + max_i(#needed).
+    # Our bidirectional ring (parallel/demand.py) delivers two trees per
+    # round -> bound 1 + ceil(max_i(#needed)/2). PARITY.md discusses the
+    # trade; round-4 measurements motivated the counter-rotation.
     los = np.array([p.min(0) for p in parts]); his = np.array([p.max(0) for p in parts])
     # box-box distance: max(0, lo_i - hi_j, lo_j - hi_i) per dim, 2-norm
     d = np.maximum(0.0, np.maximum(los[:, None, :] - his[None, :, :],
@@ -79,8 +81,27 @@ else:
     worst = np.array([o.max() for o in outs])
     needed = ((boxdist < worst[:, None]).sum(1) - 1)  # excl. self
     extra["demand_rounds_measured"] = (model.last_stats or {}).get("rounds")
-    extra["demand_rounds_theoretical_best"] = int(needed.max()) + 1
+    extra["demand_rounds_reference_best"] = int(needed.max()) + 1
+    # exact bidir-ring optimum: a needed peer at ring offset o arrives in
+    # round o (two counter-rotating copies), so the schedule cannot beat
+    # 1 + max over needed (i, j) of min(|i-j| mod R, |j-i| mod R)
+    idx = np.arange(shards)
+    offs = np.minimum((idx[:, None] - idx[None, :]) % shards,
+                      (idx[None, :] - idx[:, None]) % shards)
+    need_mask = (boxdist < worst[:, None]) & ~np.eye(shards, dtype=bool)
+    extra["demand_rounds_bidir_bound"] = (
+        int((offs * need_mask).max()) + 1 if need_mask.any() else 1)
     extra["needed_peers_per_shard"] = needed.tolist()
+
+if shards > 1:
+    # MEASURED per-round rotation bandwidth (ppermute minus no-comm
+    # control, parallel/ring.py) next to the analytic phase-level figure
+    from mpi_cuda_largescaleknn_tpu.parallel.ring import (
+        measure_exchange_bandwidth,
+    )
+    extra["exchange_measured"] = measure_exchange_bandwidth(
+        mesh, -(-n // shards), bucket_size=cfg.bucket_size,
+        engine=cfg.engine)
 
 rep = model.timers.report()
 ring = rep.get("ring") or rep.get("demand_ring") or {}

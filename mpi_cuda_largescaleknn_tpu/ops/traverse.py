@@ -21,11 +21,17 @@ the engines are exchangeable and benchmarked against each other.
 
 from __future__ import annotations
 
+import warnings
+
 import jax
 import jax.numpy as jnp
 
 from mpi_cuda_largescaleknn_tpu.core.types import CandidateState
 from mpi_cuda_largescaleknn_tpu.ops.build_tree import node_depth
+
+# beyond this many tree points per shard, the lockstep automaton's
+# divergence padding makes it pathologically slow vs the tiled engines
+_TREE_WARN_N = 200_000
 
 
 def _insert_sorted(row_d2, row_idx, d2, idx, do_insert):
@@ -58,6 +64,12 @@ def knn_update_tree(state: CandidateState, queries: jnp.ndarray,
     n = tree.shape[0]
     if n == 0:
         return state
+    if n > _TREE_WARN_N:
+        warnings.warn(
+            f"engine 'tree' with {n} points per shard: the lockstep "
+            "traversal automaton degrades badly at this size (divergence "
+            "padding) — use engine 'tiled' / 'pallas_tiled' / 'auto'",
+            RuntimeWarning, stacklevel=2)
     if tree_ids is None:
         tree_ids = jnp.arange(n, dtype=jnp.int32)
     queries = jnp.asarray(queries, jnp.float32)

@@ -25,14 +25,29 @@ overlaps with compute. What actually costs time is the *query kernel*. So:
   managed-memory float + ``cukd::atomicMax`` (:91-94, :297-298) is a masked
   ``jnp.max`` over the candidate state each round.
 
+The ring is BIDIRECTIONAL: two copies of each tree counter-rotate (one
+``ppermute`` forward, one backward), so after round r every device has seen
+all shards within ±r of its own. Round-4 measurement motivated this: with a
+forward-only ring (arrival round of shard s = (me - s) mod R) on
+spatially-sorted partitions, a device's following neighbor (index i+1)
+arrived LAST (round R-1) even though spatial locality makes it needed on
+round one — so the early exit never fired (64 rounds measured vs 33 for
+the reference's best schedule at 64 shards; after this change, 21 —
+benchmarks_report.json). Needed peers cluster around ±max_offset, and
+counter-rotation reaches offset o in round o: the loop runs at most
+floor(R/2)+1 rounds and the exit fires after max needed offset rounds.
+Total bytes moved are the same (2 trees/round x ~R/2 rounds); per-round
+link traffic doubles.
+
 Trade-off vs the reference, stated honestly: the reference visits peers
 nearest-first (tightening the prune radius fastest) and can stop after its
-*own* needs are met; the ring visits in fixed order and runs until the
-*slowest* device is done, but pays only a skipped-kernel's cost (~0) for
-unneeded shards and keeps every transfer on neighbor ICI links instead of
-arbitrary point-to-point routes. For the reference's own early-exit-friendly
-regime (spatially pre-partitioned files, README.md:17-23) both stop after
-max-over-ranks(#needed-peers) rounds.
+*own* needs are met; the bidirectional ring visits in ±1, ±2, ... order —
+which IS nearest-first in shard-index space, the right proxy when
+partitions are spatially sorted — runs until the *slowest* device is done,
+pays only a skipped-kernel's cost (~0) for unneeded shards, and keeps every
+transfer on neighbor ICI links instead of arbitrary point-to-point routes.
+Visiting two peers per round, it can finish in ceil(max_needed/2)+1 rounds
+where the reference's one-tree-per-round matching needs max_needed+1.
 
 Like the ring, the fused on-device loop (``demand_knn``) and the host-stepped
 checkpointable driver (``demand_knn_stepwise``) share one set of builders
@@ -90,6 +105,7 @@ def _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
     tiled_update = _tiled_engine_fn(engine) if use_tiled else None
     use_tree = engine == "tree"
     fwd = [(i, (i + 1) % num_shards) for i in range(num_shards)]
+    bwd = [(i, (i - 1) % num_shards) for i in range(num_shards)]
 
     def init_fn(pts_local, ids_local):
         me = jax.lax.axis_index(AXIS)
@@ -122,28 +138,30 @@ def _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
         # min distance from MY queries' box to every shard's box
         box_dist = aabb_box_distance(box.lower[None, :], box.upper[None, :],
                                      all_lower, all_upper)  # [R]
-        # shard s arrives at this device in round (me - s) mod R
-        arrival_round = jnp.mod(me - jnp.arange(num_shards), num_shards)
+        # counter-rotating copies: shard s reaches this device in round
+        # min((me - s) mod R, (s - me) mod R)
+        off = jnp.mod(me - jnp.arange(num_shards), num_shards)
+        arrival_round = jnp.minimum(off, num_shards - off)
 
         heap = pvary(init_candidates(heap_rows, k, max_radius))
         ctx = (stationary, box_dist, arrival_round, heap_valid)
-        return ctx, shard_state, heap
+        # the rotating "tree" travels twice: forward and backward copies
+        return ctx, (shard_state, shard_state), heap
 
-    def round_fn(ctx, shard_state, heap, rnd, nrun):
+    def round_fn(ctx, shard_pair, heap, rnd, nrun):
         stationary, box_dist, arrival_round, heap_valid = ctx
         me = jax.lax.axis_index(AXIS)
-        nxt = jax.tree.map(lambda a: jax.lax.ppermute(a, AXIS, fwd),
-                           shard_state)
+        f_state, b_state = shard_pair
+        nxt = (jax.tree.map(lambda a: jax.lax.ppermute(a, AXIS, fwd),
+                            f_state),
+               jax.tree.map(lambda a: jax.lax.ppermute(a, AXIS, bwd),
+                            b_state))
 
-        cur_radius = current_worst_radius(heap, heap_valid)
-        src = jnp.mod(me - rnd, num_shards)
-        # visit iff the resident shard's box is strictly closer than the
-        # current worst k-th distance (computeMyPeer's prune, :168);
-        # round 0 is the own shard at distance 0
-        do_visit = jax.lax.dynamic_index_in_dim(
-            box_dist, src, keepdims=False) < cur_radius
+        src_f = jnp.mod(me - rnd, num_shards)
+        src_b = jnp.mod(me + rnd, num_shards)
+        dup = src_f == src_b  # round 0 (own shard) and round R/2 (R even)
 
-        def run(_):
+        def run(shard_state, heap):
             if use_tiled:
                 resident = BucketedPoints(
                     shard_state[0], shard_state[1], shard_state[2],
@@ -153,10 +171,25 @@ def _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
                 st = update(heap, stationary, *shard_state)
             return st.dist2, st.idx
 
-        hd2, hidx = jax.lax.cond(do_visit, run,
+        # visit iff the resident shard's box is strictly closer than the
+        # current worst k-th distance (computeMyPeer's prune, :168);
+        # round 0 is the own shard at distance 0. The forward visit
+        # tightens the radius before the backward visit is decided — the
+        # same greedy tightening the reference gets from nearest-first.
+        cur_radius = current_worst_radius(heap, heap_valid)
+        visit_f = jax.lax.dynamic_index_in_dim(
+            box_dist, src_f, keepdims=False) < cur_radius
+        hd2, hidx = jax.lax.cond(visit_f, lambda _: run(f_state, heap),
                                  lambda _: (heap.dist2, heap.idx), None)
+        heap1 = CandidateState(hd2, hidx)
+
+        radius1 = current_worst_radius(heap1, heap_valid)
+        visit_b = (~dup) & (jax.lax.dynamic_index_in_dim(
+            box_dist, src_b, keepdims=False) < radius1)
+        hd2, hidx = jax.lax.cond(visit_b, lambda _: run(b_state, heap1),
+                                 lambda _: (heap1.dist2, heap1.idx), None)
         new_heap = CandidateState(hd2, hidx)
-        nrun = nrun + do_visit.astype(jnp.int32)
+        nrun = nrun + visit_f.astype(jnp.int32) + visit_b.astype(jnp.int32)
 
         # global early exit: does ANY device still need ANY unseen shard?
         new_radius = current_worst_radius(new_heap, heap_valid)
@@ -187,6 +220,12 @@ def _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
     return init_fn, round_fn, final_fn
 
 
+def demand_total_rounds(num_shards: int) -> int:
+    """Rounds for full coverage under the bidirectional ring: the own
+    shard at round 0, then offsets +-1, +-2, ..., +-floor(R/2)."""
+    return num_shards // 2 + 1
+
+
 def demand_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
                mesh, *, max_radius: float = jnp.inf,
                engine: str = "auto", query_tile: int = 2048,
@@ -210,9 +249,11 @@ def demand_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
     def body(pts_local, ids_local):
         ctx, shard_state, heap = init_fn(pts_local, ids_local)
 
+        total = demand_total_rounds(num_shards)
+
         def cond(carry):
             _s, _h2, _hi, rnd, keep_going, _n = carry
-            return (rnd < num_shards) & keep_going
+            return (rnd < total) & keep_going
 
         def loop_body(carry):
             shard_state, hd2, hidx, rnd, _kg, nrun = carry
@@ -310,7 +351,7 @@ def demand_knn_stepwise(points_sharded: jnp.ndarray,
             n=int(pts.shape[0]), k=int(k), shards=num_shards, engine=engine,
             max_radius=float(max_radius), bucket_size=bucket_size,
             query_tile=query_tile, point_tile=point_tile,
-            kind="demand", data=ckpt.data_digest(points_sharded, ids_sharded))
+            kind="demand-bidir", data=ckpt.data_digest(points_sharded, ids_sharded))
         got = ckpt.load_pytree(checkpoint_dir, fp,
                                (shard_state, heap, nrun), sharding)
         if got is not None:
@@ -319,17 +360,18 @@ def demand_knn_stepwise(points_sharded: jnp.ndarray,
     rnd_arr = jax.device_put(
         np.full(num_shards, start, np.int32), sharding)
     rounds_done = start
-    stop = num_shards if max_rounds is None else min(max_rounds, num_shards)
+    total = demand_total_rounds(num_shards)
+    stop = total if max_rounds is None else min(max_rounds, total)
     # "completed" = nothing left to do (early exit fired, or every shard
     # visited) — as opposed to merely truncated by the max_rounds cap
-    completed = start >= num_shards
+    completed = start >= total
     finished = start >= stop
     while not finished:
         shard_state, heap, rnd_arr, nrun, kg = step(
             ctx, shard_state, heap, rnd_arr, nrun)
         rounds_done += 1
         keep_going = bool(np.asarray(kg)[0])
-        completed = (not keep_going) or rounds_done >= num_shards
+        completed = (not keep_going) or rounds_done >= total
         finished = completed or rounds_done >= stop
         # completed runs skip the final save (their checkpoint is cleared
         # below — saving it would be wasted sync + disk IO, and a stale
