@@ -31,3 +31,24 @@ Architecture (TPU-first, not a translation):
 __version__ = "0.1.0"
 
 from mpi_cuda_largescaleknn_tpu.core.config import KnnConfig  # noqa: F401
+
+
+def kth_neighbor_distances(points, k, *, max_radius=float("inf"),
+                           num_shards: int = 0, engine: str = "auto",
+                           return_neighbors: bool = False, **config_kwargs):
+    """One-call API: distance from every point to its k-th nearest neighbor.
+
+    The library form of the reference's CLI contract
+    (``mpirun -n R ./cudaMpiKNN_unorderedData pts.float3 -o out.float -k K``):
+    ``points`` is ``f32[N, 3]`` (numpy or jax); returns ``f32[N]`` in input
+    order (``inf`` where fewer than k neighbors exist within ``max_radius``).
+    With ``return_neighbors`` also returns ``i32[N, k]`` neighbor ids —
+    something the reference computes but discards. ``num_shards=0`` uses
+    every visible device; other ``KnnConfig`` fields pass through
+    (``bucket_size``, ``query_chunk``, ``checkpoint_dir``, ...).
+    """
+    from mpi_cuda_largescaleknn_tpu.models.unordered import UnorderedKNN
+
+    cfg = KnnConfig(k=k, max_radius=max_radius, engine=engine,
+                    num_shards=num_shards, **config_kwargs)
+    return UnorderedKNN(cfg).run(points, return_neighbors=return_neighbors)

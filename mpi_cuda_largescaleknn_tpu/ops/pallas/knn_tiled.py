@@ -52,7 +52,7 @@ def _kernel(order_ref, boxd2_ref,            # SMEM: [1, 1, Bp] i32 / f32
             p_hbm, pid_hbm,                  # ANY (HBM): [Bp, 3, T] / [Bp, 1, T]
             out_d2_ref, out_idx_ref,         # VMEM: [S, k]
             vis_ref,                         # SMEM: [1, 1, 1] i32 visits
-            p_buf, id_buf, sems):            # scratch: [2,3,T], [2,1,T], (2,2)
+            p_buf, id_buf, sem_p, sem_i):    # scratch: [2,3,T], [2,1,T], (2,), (2,)
     num_pb = p_hbm.shape[0]
     kk = in_d2_ref.shape[-1]
     q = q_ref[0]                             # [S, 3]
@@ -62,11 +62,11 @@ def _kernel(order_ref, boxd2_ref,            # SMEM: [1, 1, Bp] i32 / f32
 
     def dma_pts(slot, visit):
         return pltpu.make_async_copy(p_hbm.at[visit], p_buf.at[slot],
-                                     sems.at[slot, 0])
+                                     sem_p.at[slot])
 
     def dma_ids(slot, visit):
         return pltpu.make_async_copy(pid_hbm.at[visit], id_buf.at[slot],
-                                     sems.at[slot, 1])
+                                     sem_i.at[slot])
 
     def start(slot, s):
         visit = order_ref[0, 0, s]
@@ -178,7 +178,8 @@ def _run(order, boxd2, q_pts, q_ids, in_d2, in_idx, p_t, pid_t, *, interpret):
         scratch_shapes=[
             pltpu.VMEM((2, 3, t_p), jnp.float32),
             pltpu.VMEM((2, 1, t_p), jnp.int32),
-            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),

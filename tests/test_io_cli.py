@@ -222,3 +222,19 @@ def test_native_read_failure_surfaces(tmp_path, monkeypatch):
     slab, _, total = reader.read_file_portion(str(path), 0, 1)
     assert total == 32
     np.testing.assert_array_equal(slab, pts)
+
+
+def test_one_call_api():
+    """Top-level ``kth_neighbor_distances``: the library form of the
+    unordered CLI contract."""
+    import mpi_cuda_largescaleknn_tpu as lsk
+
+    pts = random_points(500, seed=33)
+    d, idx = lsk.kth_neighbor_distances(pts, 6, num_shards=4,
+                                        bucket_size=64,
+                                        return_neighbors=True)
+    assert_dist_equal(d, kth_nn_dist(pts, pts, 6))
+    assert idx.shape == (500, 6)
+    # neighbor ids must be real rows, ascending by distance
+    self_d = np.linalg.norm(pts[:, None, :] - pts[idx], axis=-1)
+    assert np.all(np.diff(self_d, axis=1) >= -1e-6)

@@ -1,0 +1,84 @@
+"""Cross-check the MFU estimate's timing denominator.
+
+obs/cost.py divides executed distance FLOPs by the ring phase's wall time.
+This tool validates that denominator on the current backend by timing the
+same work two independent ways:
+
+1. fused driver: one jit call, ring-phase wall time (what bench.py reports);
+2. stepwise driver: per-round ``block_until_ready`` deltas summed — free of
+   the fused loop's single-dispatch structure.
+
+It reports both, their ratio, and the cost_report each implies. A ratio
+near 1 means the phase timer is measuring device time, not dispatch
+artifacts; a large gap would mean the MFU number inherits timing error.
+
+    python tools/mfu_check.py [n] [k]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main() -> int:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    k = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+
+    import jax
+    import numpy as np
+
+    from mpi_cuda_largescaleknn_tpu.obs.cost import cost_report
+    from mpi_cuda_largescaleknn_tpu.core.config import KnnConfig
+    from mpi_cuda_largescaleknn_tpu.models.unordered import UnorderedKNN
+    from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+    from mpi_cuda_largescaleknn_tpu.parallel.ring import ring_knn_stepwise
+    from mpi_cuda_largescaleknn_tpu.models.sharding import (
+        pad_and_flatten,
+        slab_bounds,
+    )
+
+    dev = jax.devices()[0]
+    platform, kind = dev.platform, getattr(dev, "device_kind", None)
+    pts = np.random.default_rng(7).random((n, 3)).astype(np.float32)
+    mesh = get_mesh(1)
+
+    # 1) fused driver, phase-timer wall time (bench.py's denominator)
+    model = UnorderedKNN(KnnConfig(k=k), mesh=mesh)
+    model.run(pts)  # compile
+    model.timers.phases.clear()
+    t0 = time.perf_counter()
+    model.run(pts)
+    fused_wall = time.perf_counter() - t0
+    fused_ring = model.timers.report()["ring"]["seconds"]
+    pair_evals = (model.last_stats or {}).get("pair_evals", 0)
+
+    # 2) stepwise driver: block_until_ready-bounded, best of 3
+    bounds = slab_bounds(n, 1)
+    flat, ids, _, _ = pad_and_flatten([pts[b:e] for b, e in bounds],
+                                      id_bases=[b for b, _ in bounds])
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        ring_knn_stepwise(flat, ids, k, mesh)
+        best = min(best, time.perf_counter() - t0)
+
+    out = {
+        "n": n, "k": k, "platform": platform, "device_kind": kind,
+        "fused_ring_phase_s": round(fused_ring, 4),
+        "fused_total_wall_s": round(fused_wall, 4),
+        "stepwise_best_s": round(best, 4),
+        "ratio_stepwise_over_fused_phase": round(best / fused_ring, 3),
+        "cost_via_fused_phase": cost_report(pair_evals, fused_ring,
+                                            platform, kind),
+        "cost_via_stepwise": cost_report(pair_evals, best, platform, kind),
+    }
+    print(json.dumps(out))
+    with open("mfu_check.json", "w") as f:
+        json.dump(out, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

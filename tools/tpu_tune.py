@@ -57,17 +57,22 @@ def main() -> int:
     cells = []
     for n in sizes:
         for engine, knobs in [
-            ("tiled", {"bucket_size": 256}),
-            ("tiled", {"bucket_size": 512}),
-            ("tiled", {"bucket_size": 1024}),
             ("pallas_tiled", {"bucket_size": 256}),
             ("pallas_tiled", {"bucket_size": 512}),
+            ("pallas_tiled", {"bucket_size": 1024}),
+            ("tiled", {"bucket_size": 512}),
+            ("tiled", {"bucket_size": 1024}),
             ("pallas", {"query_tile": 256, "point_tile": 2048}),
             ("bruteforce", {}),
         ]:
             if engine == "bruteforce" and n > 200_000:
                 continue  # O(N^2): hopeless at 1M
             cells.append({"engine": engine, "n": n, "k": 8, **knobs})
+    # the k=100 regime (BASELINE configs #2-#4): merge cost scales with k
+    cells.append({"engine": "pallas_tiled", "n": sizes[0], "k": 100,
+                  "bucket_size": 512})
+    cells.append({"engine": "tiled", "n": sizes[0], "k": 100,
+                  "bucket_size": 512})
 
     results = []
     for spec in cells:
