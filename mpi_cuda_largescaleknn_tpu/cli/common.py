@@ -24,7 +24,7 @@ TPU-side options (no reference analogue):
   --query-tile N    queries per inner tile (flat engines; default 2048)
   --point-tile N    tree points per inner tile (flat engines; default 2048)
   --bucket-size N   points per spatial bucket (tiled engine; default 512)
-  --query-chunk N   (unordered) stream queries in chunks of N rows per device;
+  --query-chunk N   stream queries in chunks of N rows per device;
                     bounds candidate-heap memory to N*k per device for runs
                     whose heaps exceed HBM (e.g. -k 100 at 100M+ points)
   --profile-dir D   write a jax.profiler trace

@@ -176,6 +176,10 @@ def run_prepartitioned_multihost(cfg: KnnConfig, in_path: str,
                              "in multi-host mode")
     if extras.get("selfcheck"):
         raise ValueError("--selfcheck is not supported in multi-host mode")
+    if cfg.query_chunk:
+        raise ValueError("--query-chunk with the prepartitioned pipeline is "
+                         "single-host only (the chunked demand driver "
+                         "assembles chunks from host-local rows)")
 
     initialize_distributed(extras["coordinator"], extras["num_hosts"],
                            extras["host_id"])

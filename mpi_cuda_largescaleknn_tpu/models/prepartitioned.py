@@ -58,21 +58,35 @@ class PrePartitionedKNN:
                 partitions, id_bases=list(sizes[:-1]))
 
         with self.timers.phase("demand_ring"):
-            run_fn = (demand_knn_stepwise if cfg.checkpoint_dir
-                      else demand_knn)
             kwargs = ({"checkpoint_dir": cfg.checkpoint_dir,
                        "checkpoint_every": cfg.checkpoint_every}
                       if cfg.checkpoint_dir else {})
+            if cfg.query_chunk > 0:
+                from mpi_cuda_largescaleknn_tpu.parallel.demand import (
+                    demand_knn_chunked,
+                )
+                run_fn = demand_knn_chunked
+                kwargs["chunk_rows"] = cfg.query_chunk
+                kwargs["return_candidates"] = return_neighbors
+            else:
+                run_fn = (demand_knn_stepwise if cfg.checkpoint_dir
+                          else demand_knn)
             dists, cands, stats = run_fn(
                 flat, ids, cfg.k, self.mesh, max_radius=cfg.max_radius,
                 engine=cfg.engine, query_tile=cfg.query_tile,
                 point_tile=cfg.point_tile, bucket_size=cfg.bucket_size,
                 return_stats=True, **kwargs)
             dists = np.asarray(dists)
+            rounds = np.asarray(stats["rounds"]).reshape(-1)
             self.last_stats = {
-                "rounds": int(np.asarray(stats["rounds"])[0]),
+                # chunked runs report per-chunk round counts; the scalar
+                # "rounds" stays comparable across drivers as the max
+                # (0 when a resumed run had nothing left to do)
+                "rounds": int(rounds.max()) if rounds.size else 0,
                 "kernels_run": np.asarray(stats["kernels_run"]).tolist(),
             }
+            if cfg.query_chunk > 0:
+                self.last_stats["rounds_per_chunk"] = rounds.tolist()
 
         with self.timers.phase("extract"):
             out = trim_per_shard(dists, counts, npad)

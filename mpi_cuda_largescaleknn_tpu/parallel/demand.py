@@ -88,12 +88,17 @@ from mpi_cuda_largescaleknn_tpu.parallel.ring import (
 
 def _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
                      bucket_size, num_shards):
-    """(init_fn, round_fn, final_fn) shared by the fused and stepwise
-    demand drivers.
+    """(init_fn, round_fn, final_fn, shard_init_fn, query_init_fn) shared by
+    the fused, stepwise, and chunked demand drivers.
 
     - init_fn(pts_local, ids_local) -> (ctx, shard_state, heap)
       ctx = (stationary queries, replicated box distances, arrival schedule,
       heap validity) — everything the loop reads but never writes.
+    - shard_init_fn(pts_local, ids_local) -> (shard_state, all_lo, all_hi)
+      (tree side + the Allgather-ed full-shard bounds)
+    - query_init_fn(qpts, qids, all_lo, all_hi) -> (ctx, heap)
+      (query side only — may be a chunk of the slab; its prune distances
+      use the CHUNK's own box, which is tighter than the slab's)
     - round_fn(ctx, shard_state, heap, rnd, nrun)
         -> (next_shard, new_heap, rnd+1, nrun', keep_going)
       keep_going is replicated (pmax) — usable as a while_loop predicate on
@@ -107,36 +112,42 @@ def _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
     fwd = [(i, (i + 1) % num_shards) for i in range(num_shards)]
     bwd = [(i, (i - 1) % num_shards) for i in range(num_shards)]
 
-    def init_fn(pts_local, ids_local):
-        me = jax.lax.axis_index(AXIS)
+    def shard_init_fn(pts_local, ids_local):
         valid = pts_local[:, 0] < PAD_SENTINEL / 2
         if use_tiled:
-            # bucketed structures: queries and the rotating shard both carry
-            # per-bucket bounds; the tile-level prune inside the tiled update
-            # subsumes most of the shard-level skip, which remains as a
-            # cheap outer gate
-            q = partition_points(pts_local, ids_local,
+            p = partition_points(pts_local, ids_local,
                                  bucket_size=bucket_size)
-            shard_state = (q.pts, q.ids, q.lower, q.upper)
-            heap_rows = q.num_buckets * q.bucket_size
-            heap_valid = (q.ids >= 0).reshape(-1)
-            stationary = q
+            shard_state = (p.pts, p.ids, p.lower, p.upper)
         elif use_tree:
             shard_state = build_tree(pts_local, ids_local)
-            heap_rows, heap_valid = pts_local.shape[0], valid
-            stationary = pts_local
         else:
             shard_state = (pts_local, ids_local)
-            heap_rows, heap_valid = pts_local.shape[0], valid
-            stationary = pts_local
-
         # bounds of every shard's real points, replicated to all devices
         # (the reference's Allgather of 6-float boxes, :290-291)
         box = aabb_of_points(pts_local, valid)
         all_lower = jax.lax.all_gather(box.lower, AXIS)   # [R, 3]
         all_upper = jax.lax.all_gather(box.upper, AXIS)
+        return shard_state, all_lower, all_upper
+
+    def query_init_fn(qpts, qids, all_lower, all_upper):
+        me = jax.lax.axis_index(AXIS)
+        valid = qpts[:, 0] < PAD_SENTINEL / 2
+        if use_tiled:
+            # bucketed structures: queries and the rotating shard both carry
+            # per-bucket bounds; the tile-level prune inside the tiled update
+            # subsumes most of the shard-level skip, which remains as a
+            # cheap outer gate
+            q = partition_points(qpts, qids, bucket_size=bucket_size)
+            heap_rows = q.num_buckets * q.bucket_size
+            heap_valid = (q.ids >= 0).reshape(-1)
+            stationary = q
+        else:
+            heap_rows, heap_valid = qpts.shape[0], valid
+            stationary = qpts
+
         # min distance from MY queries' box to every shard's box
-        box_dist = aabb_box_distance(box.lower[None, :], box.upper[None, :],
+        qbox = aabb_of_points(qpts, valid)
+        box_dist = aabb_box_distance(qbox.lower[None, :], qbox.upper[None, :],
                                      all_lower, all_upper)  # [R]
         # counter-rotating copies: shard s reaches this device in round
         # min((me - s) mod R, (s - me) mod R)
@@ -145,6 +156,12 @@ def _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
 
         heap = pvary(init_candidates(heap_rows, k, max_radius))
         ctx = (stationary, box_dist, arrival_round, heap_valid)
+        return ctx, heap
+
+    def init_fn(pts_local, ids_local):
+        shard_state, all_lower, all_upper = shard_init_fn(pts_local,
+                                                          ids_local)
+        ctx, heap = query_init_fn(pts_local, ids_local, all_lower, all_upper)
         # the rotating "tree" travels twice: forward and backward copies
         return ctx, (shard_state, shard_state), heap
 
@@ -217,7 +234,7 @@ def _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
             return dists, hd2, hidx
         return dists, heap.dist2, heap.idx
 
-    return init_fn, round_fn, final_fn
+    return init_fn, round_fn, final_fn, shard_init_fn, query_init_fn
 
 
 def demand_total_rounds(num_shards: int) -> int:
@@ -242,7 +259,7 @@ def demand_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
     engine = resolve_engine(engine)
     num_shards = mesh.shape[AXIS]
     npad = points_sharded.shape[0] // num_shards
-    init_fn, round_fn, final_fn = _make_demand_fns(
+    init_fn, round_fn, final_fn, _sif, _qif = _make_demand_fns(
         k, max_radius, engine, query_tile, point_tile, bucket_size,
         num_shards)
 
@@ -316,7 +333,7 @@ def demand_knn_stepwise(points_sharded: jnp.ndarray,
     engine = resolve_engine(engine)
     num_shards = mesh.shape[AXIS]
     npad = points_sharded.shape[0] // num_shards
-    init_fn, round_fn, final_fn = _make_demand_fns(
+    init_fn, round_fn, final_fn, _sif, _qif = _make_demand_fns(
         k, max_radius, engine, query_tile, point_tile, bucket_size,
         num_shards)
     spec = P(AXIS)
@@ -396,3 +413,157 @@ def demand_knn_stepwise(points_sharded: jnp.ndarray,
                 {"rounds": np.full(num_shards, rounds_done),
                  "kernels_run": np.asarray(nrun)})
     return np.asarray(d)
+
+
+def demand_knn_chunked(points_sharded: jnp.ndarray,
+                       ids_sharded: jnp.ndarray, k: int, mesh, *,
+                       chunk_rows: int, max_radius: float = jnp.inf,
+                       engine: str = "auto", query_tile: int = 2048,
+                       point_tile: int = 2048, bucket_size: int = 512,
+                       checkpoint_dir: str | None = None,
+                       checkpoint_every: int = 1,
+                       return_candidates: bool = False,
+                       return_stats: bool = False):
+    """``demand_knn`` with the query side streamed in fixed-size chunks.
+
+    The k=100-at-scale memory wall applies to the prepartitioned pipeline
+    exactly as to the ring (heaps are N*k*8 bytes; at BASELINE config #4's
+    full size they exceed HBM): keep every device's full shard resident,
+    hold heaps for only ``chunk_rows`` queries at a time. Each chunk runs
+    its own bidirectional early-exit loop from a PRISTINE shard pair (the
+    original never rotates, so an early exit can leave the traveling
+    copies anywhere without corrupting the next chunk), with prune
+    distances from the chunk's own (tighter) bounding box. All chunks
+    share one compiled step. With ``checkpoint_dir``, completed chunks'
+    results persist and a relaunch resumes at the first unfinished chunk.
+
+    Returns f32[R*Npad] shard-major distances (numpy), plus
+    (CandidateState, stats) per the flags; ``stats['rounds']`` is the
+    per-chunk round count list, ``kernels_run`` sums over chunks.
+    """
+    from mpi_cuda_largescaleknn_tpu.core.types import PAD_SENTINEL as _PS
+    from mpi_cuda_largescaleknn_tpu.utils import checkpoint as ckpt
+
+    engine = resolve_engine(engine)
+    num_shards = mesh.shape[AXIS]
+    _ifn, round_fn, final_fn, shard_init_fn, query_init_fn = \
+        _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
+                         bucket_size, num_shards)
+    spec = P(AXIS)
+    check_vma = not engine.startswith("pallas")
+    sharding = NamedSharding(mesh, spec)
+
+    points_sharded = np.asarray(points_sharded, np.float32)
+    ids_sharded = np.asarray(ids_sharded, np.int32)
+    npad = points_sharded.shape[0] // num_shards
+    n_chunks = max(1, -(-npad // chunk_rows))
+    total_rounds = demand_total_rounds(num_shards)
+
+    def smap(fn, n_in, out_specs):
+        return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=(spec,) * n_in,
+                                     out_specs=out_specs,
+                                     check_vma=check_vma))
+
+    pts = jax.device_put(points_sharded, sharding)
+    ids = jax.device_put(ids_sharded, sharding)
+    shard0, all_lo, all_hi = smap(shard_init_fn, 2, (spec, spec, spec))(
+        pts, ids)
+
+    qinit = smap(query_init_fn, 4, (spec, spec))
+
+    def step_fn(ctx, f_state, b_state, heap, rnd_arr, nrun):
+        nxt, heap2, rnd2, nrun2, keep_going = round_fn(
+            ctx, (f_state, b_state), heap, rnd_arr[0], nrun[0])
+        return (nxt[0], nxt[1], heap2, rnd2[None], nrun2[None],
+                keep_going.astype(jnp.int32)[None])
+
+    step = smap(step_fn, 6, (spec,) * 6)
+    final = smap(lambda c, h: _trim_rows(*final_fn(c, h), chunk_rows), 2,
+                 (spec, spec, spec))
+
+    pts_g = points_sharded.reshape(num_shards, npad, 3)
+    ids_g = ids_sharded.reshape(num_shards, npad)
+    out_d = np.full((num_shards, npad), np.inf, np.float32)
+    # candidate arrays are N*k*12 bytes — the exact memory wall this
+    # driver exists to avoid — so they materialize only on request
+    out_hd2 = (np.full((num_shards, npad, k), np.inf, np.float32)
+               if return_candidates else None)
+    out_idx = (np.full((num_shards, npad, k), -1, np.int32)
+               if return_candidates else None)
+    rounds_per_chunk: list[int] = []
+    nrun_total = np.zeros(num_shards, np.int64)
+
+    fp = None
+    start_chunk = 0
+    if checkpoint_dir:
+        fp = ckpt.fingerprint(
+            n=int(points_sharded.shape[0]), k=int(k), shards=num_shards,
+            engine=engine, max_radius=float(max_radius),
+            bucket_size=bucket_size, chunk_rows=chunk_rows,
+            query_tile=query_tile, point_tile=point_tile,
+            kind="demand-chunked", candidates=bool(return_candidates),
+            data=ckpt.data_digest(points_sharded, ids_sharded))
+        got = ckpt.load_ring_state(checkpoint_dir, fp)
+        if got is not None:
+            start_chunk, arrs = got
+            out_d = arrs["out_d"]
+            rounds_per_chunk = arrs["rounds_per_chunk"].tolist()
+            nrun_total = arrs["nrun_total"]
+            if return_candidates:
+                out_hd2, out_idx = arrs["out_hd2"], arrs["out_idx"]
+
+    for c in range(start_chunk, n_chunks):
+        lo = c * chunk_rows
+        hi = min(lo + chunk_rows, npad)
+        qp = np.full((num_shards, chunk_rows, 3), _PS, np.float32)
+        qi = np.full((num_shards, chunk_rows), -1, np.int32)
+        qp[:, :hi - lo] = pts_g[:, lo:hi]
+        qi[:, :hi - lo] = ids_g[:, lo:hi]
+        ctx, heap = qinit(
+            jax.device_put(qp.reshape(-1, 3), sharding),
+            jax.device_put(qi.reshape(-1), sharding), all_lo, all_hi)
+        # pristine pair each chunk: the resident original never rotates
+        f_state, b_state = shard0, shard0
+        rnd_arr = jax.device_put(np.zeros(num_shards, np.int32), sharding)
+        nrun = jax.device_put(np.zeros(num_shards, np.int32), sharding)
+        rounds = 0
+        while rounds < total_rounds:
+            f_state, b_state, heap, rnd_arr, nrun, kg = step(
+                ctx, f_state, b_state, heap, rnd_arr, nrun)
+            rounds += 1
+            if not bool(np.asarray(kg)[0]):
+                break
+        rounds_per_chunk.append(rounds)
+        nrun_total += np.asarray(nrun).astype(np.int64)
+        d, hd2, hidx = final(ctx, heap)
+        out_d[:, lo:hi] = np.asarray(d).reshape(
+            num_shards, chunk_rows)[:, :hi - lo]
+        if return_candidates:
+            out_hd2[:, lo:hi] = np.asarray(hd2).reshape(
+                num_shards, chunk_rows, k)[:, :hi - lo]
+            out_idx[:, lo:hi] = np.asarray(hidx).reshape(
+                num_shards, chunk_rows, k)[:, :hi - lo]
+        # never save the final chunk: the clear below follows immediately,
+        # and a stale completed-run checkpoint would otherwise survive a
+        # preemption in between (cf. the stepwise driver's same rule); a
+        # relaunch then simply redoes the last chunk
+        if checkpoint_dir and (c + 1) % checkpoint_every == 0 \
+                and c + 1 < n_chunks:
+            arrs = {"out_d": out_d,
+                    "rounds_per_chunk": np.asarray(rounds_per_chunk,
+                                                   np.int64),
+                    "nrun_total": nrun_total}
+            if return_candidates:
+                arrs.update(out_hd2=out_hd2, out_idx=out_idx)
+            ckpt.save_ring_state(checkpoint_dir, c + 1, arrs, fp)
+
+    if checkpoint_dir:
+        ckpt.clear(checkpoint_dir)
+    dists = out_d.reshape(-1)
+    cands = (CandidateState(out_hd2.reshape(-1, k), out_idx.reshape(-1, k))
+             if return_candidates else None)
+    if return_stats:
+        return dists, cands, {
+            "rounds": np.asarray(rounds_per_chunk),
+            "kernels_run": nrun_total}
+    return dists
