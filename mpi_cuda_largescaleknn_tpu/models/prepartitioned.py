@@ -14,7 +14,11 @@ from __future__ import annotations
 import numpy as np
 
 from mpi_cuda_largescaleknn_tpu.core.config import KnnConfig
-from mpi_cuda_largescaleknn_tpu.models.sharding import pad_and_flatten, trim_per_shard
+from mpi_cuda_largescaleknn_tpu.models.sharding import (
+    check_neighbor_id_capacity,
+    pad_and_flatten,
+    trim_per_shard,
+)
 from mpi_cuda_largescaleknn_tpu.obs.timers import PhaseTimers
 from mpi_cuda_largescaleknn_tpu.parallel.demand import (
     demand_knn,
@@ -45,6 +49,8 @@ class PrePartitionedKNN:
         """
         cfg = self.config
         num_shards = self.mesh.shape[AXIS]
+        if return_neighbors:
+            check_neighbor_id_capacity(sum(len(p) for p in partitions))
         if len(partitions) != num_shards:
             # the reference's "number of input files does not match MPI size"
             # (prePartitionedDataVariant.cu:215-216)

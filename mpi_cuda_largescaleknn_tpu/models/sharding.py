@@ -14,6 +14,16 @@ import numpy as np
 from mpi_cuda_largescaleknn_tpu.core.types import PAD_SENTINEL
 
 
+def check_neighbor_id_capacity(n_total: int) -> None:
+    """Neighbor-id output carries global ids as int32: ids 0..n-1 fit
+    exactly while n <= 2^31 (max id INT32_MAX). Beyond that the wrap in
+    ``pad_and_flatten`` keeps the distance path correct but makes ids
+    ambiguous — refuse rather than emit wrong identities."""
+    if n_total > 2**31:
+        raise ValueError("neighbor ids are int32: datasets beyond 2^31 "
+                         "points must use the distance-only path")
+
+
 def slab_bounds(num_total: int, num_shards: int) -> list[tuple[int, int]]:
     return [(num_total * r // num_shards, num_total * (r + 1) // num_shards)
             for r in range(num_shards)]
@@ -27,6 +37,14 @@ def pad_and_flatten(shards: list[np.ndarray], id_bases: list[int] | None = None,
     Npad = max shard size (the prepartitioned variant's pad-to-max,
     prePartitionedDataVariant.cu:251-266), padding rows = PAD_SENTINEL / id -1.
     ``id_bases[r]`` is shard r's global index offset (slab begin).
+
+    Beyond 2^31 total points the global id no longer fits int32 — a naive
+    base+arange would wrap NEGATIVE and the engines would treat real points
+    as padding (silent data loss). The distance path only ever consults the
+    SIGN of an id (valid vs padding; merges order by distance alone), so
+    ids wrap modulo 2^31 and stay non-negative; neighbor-id output at that
+    scale is refused upstream (``--write-indices`` documents the int32
+    limit).
     """
     num_shards = len(shards)
     counts = [len(s) for s in shards]
@@ -37,7 +55,8 @@ def pad_and_flatten(shards: list[np.ndarray], id_bases: list[int] | None = None,
     for r, s in enumerate(shards):
         points[r * npad:r * npad + counts[r]] = np.asarray(s, np.float32)
         base = id_bases[r] if id_bases is not None else 0
-        ids[r * npad:r * npad + counts[r]] = base + np.arange(counts[r], dtype=np.int32)
+        gids = (base + np.arange(counts[r], dtype=np.int64)) % (2**31)
+        ids[r * npad:r * npad + counts[r]] = gids.astype(np.int32)
     return points, ids, counts, npad
 
 

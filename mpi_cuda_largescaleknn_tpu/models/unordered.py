@@ -14,6 +14,7 @@ import numpy as np
 
 from mpi_cuda_largescaleknn_tpu.core.config import KnnConfig
 from mpi_cuda_largescaleknn_tpu.models.sharding import (
+    check_neighbor_id_capacity,
     pad_and_flatten,
     slab_bounds,
     trim_per_shard,
@@ -52,6 +53,8 @@ class UnorderedKNN:
         cfg = self.config
         num_shards = self.mesh.shape[AXIS]
         n_total = len(points)
+        if return_neighbors:
+            check_neighbor_id_capacity(n_total)
 
         with self.timers.phase("shard_and_pad"):
             bounds = slab_bounds(n_total, num_shards)

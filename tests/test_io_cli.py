@@ -238,3 +238,17 @@ def test_one_call_api():
     # neighbor ids must be real rows, ascending by distance
     self_d = np.linalg.norm(pts[:, None, :] - pts[idx], axis=-1)
     assert np.all(np.diff(self_d, axis=1) >= -1e-6)
+
+
+def test_pad_and_flatten_ids_beyond_int32():
+    """At >2^31 global points, ids wrap modulo 2^31 but must stay
+    NON-NEGATIVE — a negative wrap would silently classify real points as
+    padding (the engines test id sign for validity)."""
+    from mpi_cuda_largescaleknn_tpu.models.sharding import pad_and_flatten
+
+    base = 2**31 - 3  # global offset of a deep shard in a 10B-point run
+    _, ids, counts, _ = pad_and_flatten([random_points(8, seed=4)],
+                                        id_bases=[base])
+    assert counts == [8]
+    assert np.all(ids[:8] >= 0), ids[:8]
+    assert ids[0] == 2**31 - 3 and ids[3] == 0  # wrapped, not negative
