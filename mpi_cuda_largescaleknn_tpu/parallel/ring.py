@@ -3,12 +3,14 @@
 TPU-native re-design of the reference's MPI ring
 (unorderedDataVariant.cu:173-205): R ranks each hold a tree shard and a set of
 stationary queries with persistent candidate heaps; each round every rank
-queries the currently-resident shard, then passes it to ``(rank+1) % R`` and
-receives from ``(rank-1+size) % R``. After R rounds every shard has visited
-every rank and each heap holds the global top-k. This is the same
-communication/accumulation shape as ring attention (stationary Q, rotating
-K/V, running accumulator) and maps 1:1 onto a ``lax.ppermute`` over the ICI
-ring inside ``shard_map``.
+queries the currently-resident shard(s), then rotates them. After a full
+sweep every shard has visited every rank and each heap holds the global
+top-k. This is the same communication/accumulation shape as ring attention
+(stationary Q, rotating K/V, running accumulator) and maps 1:1 onto
+``lax.ppermute`` over the ICI ring inside ``shard_map`` — here with TWO
+counter-rotating copies per tree (see ``_make_ring_fns``): ICI links are
+full-duplex, so both directions carry trees simultaneously and the sweep
+takes R//2+1 rounds instead of the reference's R.
 
 Deliberate improvements over the reference (not bugs to replicate):
 
@@ -126,21 +128,45 @@ def _make_ring_fns(k, max_radius, engine, query_tile, point_tile, bucket_size,
     per-round pieces every ring driver executes, defined once so the fused,
     stepwise and chunked paths cannot diverge.
 
-    - init_fn(pts_local, ids_local) -> (stationary, shard, heap)
+    - init_fn(pts_local, ids_local) -> (stationary, shard_pair, heap)
       (classic path: the slab is both tree shard and queries)
-    - shard_init_fn(pts_local, ids_local) -> shard (tree side only)
+    - shard_init_fn(pts_local, ids_local) -> shard (tree side only; drivers
+      pair it as (shard, shard) — see below)
     - query_init_fn(qpts_local, qids_local) -> (stationary, heap)
       (query side only — may be a chunk of the slab)
-    - round_fn(stationary, shard, heap) -> (next_shard, new_heap, tiles)
-      (issues the rotation before the fold so XLA overlaps them; ``tiles``
+    - round_fn(stationary, shard_pair, heap, rnd)
+        -> (next_pair, new_heap, tiles)
+      (issues the rotations before the folds so XLA overlaps them; ``tiles``
       is i32[1]: distance tiles this device actually computed — real counts
       for the pruned tiled engines, 0 for flat engines whose all-pairs count
       is analytic and added by the drivers)
     - final_fn(stationary, heap, npad) -> (dists, hd2, hidx) in input-row
       order per shard
+
+    The ring is BIDIRECTIONAL: two copies of each tree counter-rotate, one
+    ``ppermute`` per direction, so the full sweep takes
+    ``ring_total_rounds(R) = R//2 + 1`` rounds of (up to) two folds instead
+    of R rounds of one. Same total bytes and folds — but ICI links are
+    full-duplex, so using both directions at once halves the exchange
+    wall-clock the reference's one-direction ring pays
+    (unorderedDataVariant.cu:178-193), and the loop/dispatch overhead
+    halves with the round count. ``rnd`` disambiguates the two duplicate
+    deliveries (round 0: both copies are the own shard; round R/2 for even
+    R: both copies are the antipodal shard) — the backward fold is skipped
+    there, keeping every shard folded exactly once.
     """
     use_tiled = engine in ("tiled", "auto", "pallas_tiled")
     fwd = [(i, (i + 1) % num_shards) for i in range(num_shards)]
+    bwd = [(i, (i - 1) % num_shards) for i in range(num_shards)]
+
+    def rotate_pair(shard_pair):
+        f, b = shard_pair
+        return (jax.tree.map(lambda a: jax.lax.ppermute(a, AXIS, fwd), f),
+                jax.tree.map(lambda a: jax.lax.ppermute(a, AXIS, bwd), b))
+
+    def is_dup(rnd):
+        # round 0 (own shard twice) and, for even R, round R/2 (antipode)
+        return (rnd == 0) | (2 * rnd == num_shards)
 
     if use_tiled:
         tiled_update = _tiled_engine_fn(engine)
@@ -152,16 +178,29 @@ def _make_ring_fns(k, max_radius, engine, query_tile, point_tile, bucket_size,
                                          max_radius))
             return q, heap
 
-        def round_fn(q, shard, heap):
-            nxt = jax.tree.map(lambda a: jax.lax.ppermute(a, AXIS, fwd),
-                               shard)
+        def fold_one(q, shard, heap):
             # the resident shard keeps its OWN bucket geometry (it may differ
             # from the query side's under chunked queries); pos is
             # query-side-only metadata, ids stand in for it
             resident = BucketedPoints(shard[0], shard[1], shard[2], shard[3],
                                       shard[1])
-            st, tiles = tiled_update(heap, q, resident, with_stats=True)
-            return nxt, st, tiles[None]
+            return tiled_update(heap, q, resident, with_stats=True)
+
+        def round_fn(q, shard_pair, heap, rnd):
+            nxt = rotate_pair(shard_pair)
+            f, b = shard_pair
+            st, tiles_f = fold_one(q, f, heap)
+
+            def fold_b(_):
+                st2, t2 = fold_one(q, b, st)
+                return st2.dist2, st2.idx, t2
+
+            hd2, hidx, tiles_b = jax.lax.cond(
+                # tiles_f * 0, not a fresh zero: the constant would be
+                # replicated and mismatch fold_b's axis-varying count
+                is_dup(rnd), lambda _: (st.dist2, st.idx, tiles_f * 0),
+                fold_b, None)
+            return nxt, CandidateState(hd2, hidx), (tiles_f + tiles_b)[None]
 
         def final_fn(q, heap, npad):
             kk = heap.dist2.shape[-1]
@@ -185,7 +224,8 @@ def _make_ring_fns(k, max_radius, engine, query_tile, point_tile, bucket_size,
             # (reference uploads it twice, unorderedDataVariant.cu:159-167);
             # partition once, derive both sides from it
             q, heap = query_init_fn(pts_local, ids_local)
-            return q, (q.pts, q.ids, q.lower, q.upper), heap
+            shard = (q.pts, q.ids, q.lower, q.upper)
+            return q, (shard, shard), heap
     else:
         update = _engine_fn(engine, query_tile, point_tile)
         use_tree = engine == "tree"
@@ -194,12 +234,17 @@ def _make_ring_fns(k, max_radius, engine, query_tile, point_tile, bucket_size,
             heap = pvary(init_candidates(qpts_local.shape[0], k, max_radius))
             return qpts_local, heap
 
-        def round_fn(queries, shard, heap):
-            nxt = jax.tree.map(lambda a: jax.lax.ppermute(a, AXIS, fwd),
-                               shard)
-            st = update(heap, queries, shard[0], shard[1])
+        def round_fn(queries, shard_pair, heap, rnd):
+            nxt = rotate_pair(shard_pair)
+            f, b = shard_pair
+            st = update(heap, queries, f[0], f[1])
+            hd2, hidx = jax.lax.cond(
+                is_dup(rnd), lambda _: (st.dist2, st.idx),
+                lambda _: (lambda s2: (s2.dist2, s2.idx))(
+                    update(st, queries, b[0], b[1])), None)
+            st = CandidateState(hd2, hidx)
             # flat engines score every pair: the count is analytic
-            # (n_q * n_p per device-round), added host-side by the drivers
+            # (n_q * n_p per device-fold), added host-side by the drivers
             return nxt, st, pvary(jnp.zeros((1,), jnp.int32))
 
         def final_fn(_queries, heap, _npad):
@@ -212,9 +257,34 @@ def _make_ring_fns(k, max_radius, engine, query_tile, point_tile, bucket_size,
 
         def init_fn(pts_local, ids_local):
             q, heap = query_init_fn(pts_local, ids_local)
-            return q, shard_init_fn(pts_local, ids_local), heap
+            shard = shard_init_fn(pts_local, ids_local)
+            return q, (shard, shard), heap
 
     return init_fn, round_fn, final_fn, shard_init_fn, query_init_fn
+
+
+def _pair_step_fn(round_fn):
+    """Flat-argument step wrapper shared by the stepwise and chunked
+    drivers (shard_map wants leaf-wise specs; the pair and round counter
+    ride as separate arguments and the counter self-increments)."""
+    def step_fn(stationary, f_state, b_state, heap, rnd_arr):
+        nxt, st, t = round_fn(stationary, (f_state, b_state), heap,
+                              rnd_arr[0])
+        return nxt[0], nxt[1], st, t, rnd_arr + 1
+    return step_fn
+
+
+def _folds_in_rounds(start: int, stop: int, num_shards: int) -> int:
+    """Folds the bidirectional ring executes in rounds [start, stop):
+    1 in round 0 and in the even-R antipodal round, else 2."""
+    return sum(1 if (r == 0 or 2 * r == num_shards) else 2
+               for r in range(start, stop))
+
+
+def ring_total_rounds(num_shards: int) -> int:
+    """Rounds for a full bidirectional sweep: the own shard at round 0,
+    then offsets +-1, ..., +-floor(R/2)."""
+    return num_shards // 2 + 1
 
 
 def _ring_stats(engine: str, tiles_total: int, bucket_size: int,
@@ -273,13 +343,15 @@ def ring_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
         k, max_radius, engine, query_tile, point_tile, bucket_size,
         num_shards)
 
+    total_rounds = ring_total_rounds(num_shards)
+
     def body(pts_local, ids_local):
-        stationary, shard, heap = init_fn(pts_local, ids_local)
+        stationary, pair, heap = init_fn(pts_local, ids_local)
 
         def round_body(i, carry):
-            shard, hd2, hidx, tiles = carry
-            nxt, st, t = round_fn(stationary, shard,
-                                  CandidateState(hd2, hidx))
+            pair, hd2, hidx, tiles = carry
+            nxt, st, t = round_fn(stationary, pair,
+                                  CandidateState(hd2, hidx), i)
             # one slot per round, not a running i32 sum: a single round's
             # count fits int32 comfortably, but the total at reference
             # scale does not — the host sums the slots in int64
@@ -287,9 +359,9 @@ def ring_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
             return nxt, st.dist2, st.idx, tiles
 
         _, hd2, hidx, tiles = jax.lax.fori_loop(
-            0, num_shards, round_body,
-            (shard, heap.dist2, heap.idx,
-             pvary(jnp.zeros((num_shards,), jnp.int32))))
+            0, total_rounds, round_body,
+            (pair, heap.dist2, heap.idx,
+             pvary(jnp.zeros((total_rounds,), jnp.int32))))
         return final_fn(stationary, CandidateState(hd2, hidx),
                         pts_local.shape[0]) + (tiles,)
 
@@ -371,34 +443,40 @@ def ring_knn_stepwise(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
         fp = ckpt.fingerprint(
             n=int(pts.shape[0]), k=int(k), shards=num_shards, engine=engine,
             max_radius=float(max_radius), bucket_size=bucket_size,
-            query_tile=query_tile, point_tile=point_tile,
+            query_tile=query_tile, point_tile=point_tile, ring="bidir",
             data=ckpt.data_digest(points_sharded, ids_sharded))
 
-    stationary, shard, heap = smap(init_fn, 2, (spec, spec, spec))(pts, ids)
-    step = smap(round_fn, 3, (spec, spec, spec))
+    stationary, pair, heap = smap(init_fn, 2, (spec, spec, spec))(pts, ids)
+
+    step = smap(_pair_step_fn(round_fn), 5, (spec, spec, spec, spec, spec))
 
     start = 0
     if checkpoint_dir:
-        got = ckpt.load_pytree(checkpoint_dir, fp, (shard, heap), sharding)
+        got = ckpt.load_pytree(checkpoint_dir, fp, (pair, heap), sharding)
         if got is not None:
-            start, (shard, heap) = got
+            start, (pair, heap) = got
 
+    total_rounds = ring_total_rounds(num_shards)
     tiles_parts = []  # device arrays; materialized ONCE after the loop so
     rounds_run = 0    # the non-stats path keeps its async round dispatch
-    stop = num_shards if max_rounds is None else min(max_rounds, num_shards)
+    stop = (total_rounds if max_rounds is None
+            else min(max_rounds, total_rounds))
+    rnd_arr = jax.device_put(np.full(num_shards, start, np.int32), sharding)
     for r in range(start, stop):
-        shard, heap, tiles = step(stationary, shard, heap)
+        f_state, b_state, heap, tiles, rnd_arr = step(
+            stationary, pair[0], pair[1], heap, rnd_arr)
+        pair = (f_state, b_state)
         if return_stats:
             tiles_parts.append(tiles)
         rounds_run += 1
         if checkpoint_dir and ((r + 1) % checkpoint_every == 0
                                or r + 1 == stop):
-            ckpt.save_pytree(checkpoint_dir, r + 1, (shard, heap), fp)
+            ckpt.save_pytree(checkpoint_dir, r + 1, (pair, heap), fp)
 
     dists, hd2, hidx = smap(
         lambda s, h: final_fn(s, h, npad_local), 2,
         (spec, spec, spec))(stationary, heap)
-    if checkpoint_dir and stop == num_shards:
+    if checkpoint_dir and stop == total_rounds:
         # done: clear so a later (possibly different-data) run in the same
         # dir can never resume past its own work
         ckpt.clear(checkpoint_dir)
@@ -407,9 +485,12 @@ def ring_knn_stepwise(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
         out += (CandidateState(hd2, hidx),)
     if return_stats:
         tiles_total = int(np.sum([np.asarray(t).sum() for t in tiles_parts]))
+        # analytic fold count for flat engines, exact for resumed
+        # sessions too (round 0 and the even-R antipodal round fold once)
+        folds = _folds_in_rounds(start, stop, num_shards)
         out += (_ring_stats(
             engine, tiles_total, bucket_size,
-            rounds_run * num_shards * npad_local * npad_local,
+            folds * num_shards * npad_local * npad_local,
             q_rows=npad_local, p_rows=npad_local),)
     return out if len(out) > 1 else out[0]
 
@@ -515,11 +596,14 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
             return rows
         return np.asarray(garr).reshape((num_shards, chunk_rows) + width)
 
-    shard = smap(shard_init_fn, 2, spec)(pts_glob, ids_glob)
+    shard0 = smap(shard_init_fn, 2, spec)(pts_glob, ids_glob)
     qinit = smap(query_init_fn, 2, (spec, spec))
-    step = smap(round_fn, 3, (spec, spec, spec))
+
+    step = smap(_pair_step_fn(round_fn), 5, (spec, spec, spec, spec, spec))
     final = smap(lambda s, h: final_fn(s, h, chunk_rows), 2,
                  (spec, spec, spec))
+    total_rounds = ring_total_rounds(num_shards)
+    rnd0 = to_global(np.zeros(n_my, np.int32), num_shards)
 
     out_d = np.full((n_my, npad_local), np.inf, np.float32)
     out_hd2 = (np.full((n_my, npad_local, k), np.inf, np.float32)
@@ -572,8 +656,14 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
             to_global(qp.reshape(-1, 3), num_shards * chunk_rows),
             to_global(qi.reshape(-1), num_shards * chunk_rows))
         chunks_run += 1
-        for _r in range(num_shards):
-            shard, heap, tiles = step(stationary, shard, heap)
+        # pristine pair each chunk: the resident original never rotates, so
+        # the traveling copies can be discarded wherever the sweep ends
+        pair = (shard0, shard0)
+        rnd_arr = rnd0
+        for _r in range(total_rounds):
+            f_state, b_state, heap, tiles, rnd_arr = step(
+                stationary, pair[0], pair[1], heap, rnd_arr)
+            pair = (f_state, b_state)
             if return_stats:
                 tiles_parts.append(tiles)
         d, hd2, hidx = final(stationary, heap)
@@ -626,17 +716,18 @@ def measure_exchange_bandwidth(mesh, npad_local: int, *, reps: int = 10,
                                engine: str = "auto") -> dict:
     """MEASURED per-round ring-rotation bandwidth (not analytic).
 
-    Times the jitted ``ppermute`` rotation of a representative shard pytree
-    (same shapes/dtypes the ring actually rotates) in isolation: best of
-    ``reps`` ``block_until_ready`` wall-clock deltas, minus a no-comm
-    control (the same jitted program with the ppermute replaced by an
-    elementwise touch) to remove dispatch overhead. Bytes counted once per
-    hop: every device sends its whole shard each round, so a round moves
-    ``num_shards * shard_bytes`` across the links in parallel; the reported
-    figure is per-device link bandwidth ``shard_bytes / t`` plus the
-    aggregate. The reference's equivalent transfer is the ring Isend/Irecv
-    of tree buffers (unorderedDataVariant.cu:189-193), which it never
-    times (SURVEY.md §5)."""
+    Times the jitted rotation of a representative shard pytree (same
+    shapes/dtypes the ring actually rotates — BOTH counter-rotating copies,
+    one ``ppermute`` per direction, as the bidirectional ring moves them)
+    in isolation: best of ``reps`` ``block_until_ready`` wall-clock deltas,
+    minus a no-comm control (the same jitted program with the ppermutes
+    replaced by an elementwise touch) to remove dispatch overhead. Every
+    device sends its whole shard in each direction per round
+    (``2 * shard_bytes``); the reported per-link figure counts both
+    directions of the full-duplex link. The reference's equivalent transfer
+    is the ring Isend/Irecv of tree buffers
+    (unorderedDataVariant.cu:189-193), which it never times (SURVEY.md §5).
+    """
     import time as _time
 
     engine = resolve_engine(engine)
@@ -661,17 +752,22 @@ def measure_exchange_bandwidth(mesh, npad_local: int, *, reps: int = 10,
                        sharding)
         for a in shard_local)
 
+    bwd = [(i, (i - 1) % num_shards) for i in range(num_shards)]
+
     def rotate(*shard):
-        return tuple(jax.lax.ppermute(a, AXIS, fwd) for a in shard)
+        # both directions in flight, as in the real ring round
+        return (tuple(jax.lax.ppermute(a, AXIS, fwd) for a in shard)
+                + tuple(jax.lax.ppermute(a, AXIS, bwd) for a in shard))
 
     def touch(*shard):
-        return tuple(a + jnp.zeros((), a.dtype) for a in shard)
+        return (tuple(a + jnp.zeros((), a.dtype) for a in shard)
+                + tuple(a + jnp.ones((), a.dtype) for a in shard))
 
     n_in = len(shard_local)
     rot = jax.jit(jax.shard_map(rotate, mesh=mesh, in_specs=(spec,) * n_in,
-                                out_specs=(spec,) * n_in))
+                                out_specs=(spec,) * (2 * n_in)))
     ctl = jax.jit(jax.shard_map(touch, mesh=mesh, in_specs=(spec,) * n_in,
-                                out_specs=(spec,) * n_in))
+                                out_specs=(spec,) * (2 * n_in)))
 
     def best_of(fn):
         out = fn(*glob)  # compile + warm
@@ -687,15 +783,17 @@ def measure_exchange_bandwidth(mesh, npad_local: int, *, reps: int = 10,
     t_rot = best_of(rot)
     t_ctl = best_of(ctl)
     t_comm = max(t_rot - t_ctl, 1e-9)
+    round_bytes_per_device = 2 * shard_bytes  # both directions, full duplex
     return {
-        "method": "jitted ppermute rotation, best of %d, minus no-comm "
-                  "control" % reps,
+        "method": "jitted bidirectional ppermute rotation, best of %d, "
+                  "minus no-comm control" % reps,
         "platform": jax.devices()[0].platform,
         "num_shards": num_shards,
         "shard_bytes": shard_bytes,
         "round_seconds": round(t_comm, 6),
         "control_seconds": round(t_ctl, 6),
-        "exchange_GB_per_sec_per_link": round(shard_bytes / t_comm / 1e9, 3),
+        "exchange_GB_per_sec_per_link": round(
+            round_bytes_per_device / t_comm / 1e9, 3),
         "exchange_GB_per_sec_aggregate": round(
-            num_shards * shard_bytes / t_comm / 1e9, 3),
+            num_shards * round_bytes_per_device / t_comm / 1e9, 3),
     }

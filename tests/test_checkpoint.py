@@ -60,7 +60,7 @@ def test_resume_from_partial_checkpoint(tmp_path):
     fp = ckpt.fingerprint(n=int(flat.shape[0]), k=5, shards=8,
                           engine=resolve_engine("auto"),
                           max_radius=float(np.inf), bucket_size=16,
-                          query_tile=2048, point_tile=2048,
+                          query_tile=2048, point_tile=2048, ring="bidir",
                           data=ckpt.data_digest(flat, ids))
     rnd, _arrs = ckpt.load_ring_state(cdir, fp)
     assert rnd == 3

@@ -118,3 +118,13 @@ def test_prepartitioned_query_chunk_overlapping_oracle():
         # ids index the global concatenation; distances ascend per row
         nd = np.linalg.norm(part[:, None, :] - allp[ix], axis=-1)
         assert np.all(np.diff(nd, axis=1) >= -1e-6)
+
+
+def test_demand_chunked_radius_semantics():
+    parts = _tiled_partitions(4, 60, gap=5.0, seed=41)
+    r = 0.25
+    got = PrePartitionedKNN(_cfg(k=30, max_radius=r, query_chunk=16),
+                            mesh=get_mesh(4)).run(parts)
+    allp = np.concatenate(parts)
+    for part, d in zip(parts, got):
+        assert_dist_equal(d, kth_nn_dist(part, allp, 30, max_radius=r))
