@@ -63,11 +63,17 @@ class UnorderedKNN:
                 shards, id_bases=[b for b, _ in bounds])
 
         cands = None
-        # tree bytes x rounds; the chunked path rotates a full ring per chunk
+        # tree bytes x rotations: the bidirectional sweep rotates two
+        # copies per device for ring_total_rounds-1 rounds (the final
+        # round is fold-only); the chunked path repeats that per chunk
+        from mpi_cuda_largescaleknn_tpu.parallel.ring import (
+            ring_total_rounds,
+        )
         n_chunks = (max(1, -(-npad // cfg.query_chunk))
                     if cfg.query_chunk > 0 else 1)
+        rotations = 2 * (ring_total_rounds(num_shards) - 1)
         with self.timers.phase("ring", bytes_moved=(
-                num_shards * npad * 12 * num_shards * n_chunks)):
+                num_shards * npad * 12 * rotations * n_chunks)):
             if cfg.query_chunk > 0:
                 got = ring_knn_chunked(
                     flat, ids, cfg.k, self.mesh, max_radius=cfg.max_radius,

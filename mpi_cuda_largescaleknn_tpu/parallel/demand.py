@@ -83,6 +83,7 @@ from mpi_cuda_largescaleknn_tpu.parallel.ring import (
     _engine_fn,
     _tiled_engine_fn,
     resolve_engine,
+    ring_total_rounds,
 )
 
 
@@ -237,10 +238,8 @@ def _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
     return init_fn, round_fn, final_fn, shard_init_fn, query_init_fn
 
 
-def demand_total_rounds(num_shards: int) -> int:
-    """Rounds for full coverage under the bidirectional ring: the own
-    shard at round 0, then offsets +-1, +-2, ..., +-floor(R/2)."""
-    return num_shards // 2 + 1
+# one bidirectional-sweep definition for both engines (ring.py)
+demand_total_rounds = ring_total_rounds
 
 
 def demand_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,

@@ -186,8 +186,11 @@ def _make_ring_fns(k, max_radius, engine, query_tile, point_tile, bucket_size,
                                       shard[1])
             return tiled_update(heap, q, resident, with_stats=True)
 
-        def round_fn(q, shard_pair, heap, rnd):
-            nxt = rotate_pair(shard_pair)
+        def round_fn(q, shard_pair, heap, rnd, rotate=True):
+            # the final round's rotation would be discarded — callers pass
+            # rotate=False there (static flag: collectives cannot sit
+            # under a traced cond)
+            nxt = rotate_pair(shard_pair) if rotate else shard_pair
             f, b = shard_pair
             st, tiles_f = fold_one(q, f, heap)
 
@@ -234,8 +237,8 @@ def _make_ring_fns(k, max_radius, engine, query_tile, point_tile, bucket_size,
             heap = pvary(init_candidates(qpts_local.shape[0], k, max_radius))
             return qpts_local, heap
 
-        def round_fn(queries, shard_pair, heap, rnd):
-            nxt = rotate_pair(shard_pair)
+        def round_fn(queries, shard_pair, heap, rnd, rotate=True):
+            nxt = rotate_pair(shard_pair) if rotate else shard_pair
             f, b = shard_pair
             st = update(heap, queries, f[0], f[1])
             hd2, hidx = jax.lax.cond(
@@ -263,13 +266,15 @@ def _make_ring_fns(k, max_radius, engine, query_tile, point_tile, bucket_size,
     return init_fn, round_fn, final_fn, shard_init_fn, query_init_fn
 
 
-def _pair_step_fn(round_fn):
+def _pair_step_fn(round_fn, rotate=True):
     """Flat-argument step wrapper shared by the stepwise and chunked
     drivers (shard_map wants leaf-wise specs; the pair and round counter
-    ride as separate arguments and the counter self-increments)."""
+    ride as separate arguments and the counter self-increments).
+    ``rotate=False`` builds the final-round variant whose (discarded)
+    rotation is skipped."""
     def step_fn(stationary, f_state, b_state, heap, rnd_arr):
         nxt, st, t = round_fn(stationary, (f_state, b_state), heap,
-                              rnd_arr[0])
+                              rnd_arr[0], rotate=rotate)
         return nxt[0], nxt[1], st, t, rnd_arr + 1
     return step_fn
 
@@ -358,12 +363,16 @@ def ring_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
             tiles = jax.lax.dynamic_update_index_in_dim(tiles, t[0], i, 0)
             return nxt, st.dist2, st.idx, tiles
 
-        _, hd2, hidx, tiles = jax.lax.fori_loop(
-            0, total_rounds, round_body,
+        pair, hd2, hidx, tiles = jax.lax.fori_loop(
+            0, total_rounds - 1, round_body,
             (pair, heap.dist2, heap.idx,
              pvary(jnp.zeros((total_rounds,), jnp.int32))))
-        return final_fn(stationary, CandidateState(hd2, hidx),
-                        pts_local.shape[0]) + (tiles,)
+        # final round: fold only — its rotation would be discarded
+        _, st, t = round_fn(stationary, pair, CandidateState(hd2, hidx),
+                            jnp.int32(total_rounds - 1), rotate=False)
+        tiles = jax.lax.dynamic_update_index_in_dim(
+            tiles, t[0], total_rounds - 1, 0)
+        return final_fn(stationary, st, pts_local.shape[0]) + (tiles,)
 
     shard_spec = P(AXIS)
     # interpret-mode pallas kernels re-evaluate a vma-less kernel jaxpr with
@@ -449,6 +458,8 @@ def ring_knn_stepwise(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
     stationary, pair, heap = smap(init_fn, 2, (spec, spec, spec))(pts, ids)
 
     step = smap(_pair_step_fn(round_fn), 5, (spec, spec, spec, spec, spec))
+    step_last = smap(_pair_step_fn(round_fn, rotate=False), 5,
+                     (spec, spec, spec, spec, spec))
 
     start = 0
     if checkpoint_dir:
@@ -463,7 +474,8 @@ def ring_knn_stepwise(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
             else min(max_rounds, total_rounds))
     rnd_arr = jax.device_put(np.full(num_shards, start, np.int32), sharding)
     for r in range(start, stop):
-        f_state, b_state, heap, tiles, rnd_arr = step(
+        fn = step_last if r == total_rounds - 1 else step
+        f_state, b_state, heap, tiles, rnd_arr = fn(
             stationary, pair[0], pair[1], heap, rnd_arr)
         pair = (f_state, b_state)
         if return_stats:
@@ -600,6 +612,8 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
     qinit = smap(query_init_fn, 2, (spec, spec))
 
     step = smap(_pair_step_fn(round_fn), 5, (spec, spec, spec, spec, spec))
+    step_last = smap(_pair_step_fn(round_fn, rotate=False), 5,
+                     (spec, spec, spec, spec, spec))
     final = smap(lambda s, h: final_fn(s, h, chunk_rows), 2,
                  (spec, spec, spec))
     total_rounds = ring_total_rounds(num_shards)
@@ -661,7 +675,8 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
         pair = (shard0, shard0)
         rnd_arr = rnd0
         for _r in range(total_rounds):
-            f_state, b_state, heap, tiles, rnd_arr = step(
+            fn = step_last if _r == total_rounds - 1 else step
+            f_state, b_state, heap, tiles, rnd_arr = fn(
                 stationary, pair[0], pair[1], heap, rnd_arr)
             pair = (f_state, b_state)
             if return_stats:

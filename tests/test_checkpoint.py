@@ -42,15 +42,17 @@ def test_stepwise_flat_engine_matches_fused():
 
 
 def test_resume_from_partial_checkpoint(tmp_path):
-    """Die after round 3 of 8; a fresh run resumes there and matches
-    the uninterrupted result bit-for-bit."""
+    """Die after round 3 of the 5-round bidirectional sweep (R=8); a
+    fresh run resumes there and matches the uninterrupted result
+    bit-for-bit."""
     pts = random_points(480, seed=7)
     mesh = get_mesh(8)
     flat, ids, _, _ = _sharded(pts, 8)
     cdir = str(tmp_path / "ck")
     want = ring_knn_stepwise(flat, ids, 5, mesh, bucket_size=16)
 
-    # interrupted run: only 3 of 8 rounds execute before the "crash"
+    # interrupted run: only 3 of the 5 sweep rounds execute (shards seen:
+    # own, +-1, +-2 of 8) before the "crash"
     partial = ring_knn_stepwise(flat, ids, 5, mesh, bucket_size=16,
                                 checkpoint_dir=cdir, max_rounds=3)
     from mpi_cuda_largescaleknn_tpu.parallel.ring import resolve_engine
@@ -67,7 +69,7 @@ def test_resume_from_partial_checkpoint(tmp_path):
     # 3 rounds cannot have visited all shards: partial must differ from final
     assert not np.array_equal(partial, want)
 
-    # relaunch with the same args: resumes at round 3, replays 3..7
+    # relaunch with the same args: resumes at round 3, replays 3..4
     resumed = ring_knn_stepwise(flat, ids, 5, mesh, bucket_size=16,
                                 checkpoint_dir=cdir)
     np.testing.assert_array_equal(resumed, want)
