@@ -36,7 +36,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax import lax
 
-from mpi_cuda_largescaleknn_tpu.core.types import CandidateState
+from mpi_cuda_largescaleknn_tpu.core.types import PAD_SENTINEL, CandidateState
 from mpi_cuda_largescaleknn_tpu.ops.pallas.knn_bf import (
     fold_tile_into_candidates,
 )
@@ -49,10 +49,10 @@ from mpi_cuda_largescaleknn_tpu.ops.partition import (
 def _kernel(order_ref, boxd2_ref,            # SMEM: [1, 1, Bp] i32 / f32
             q_ref, qid_ref,                  # VMEM: [1, S, 3] / [1, S, 1]
             in_d2_ref, in_idx_ref,           # VMEM: [S, k]
-            p_hbm, pid_hbm,                  # ANY (HBM): [Bp, 3, T] / [Bp, 1, T]
+            p_hbm, pid_hbm,                  # ANY (HBM): [Bp, 4, T] / [Bp, 1, T]
             out_d2_ref, out_idx_ref,         # VMEM: [S, k]
             vis_ref,                         # SMEM: [1, 1, 1] i32 visits
-            p_buf, id_buf, sem_p, sem_i):    # scratch: [2,3,T], [2,1,T], (2,), (2,)
+            p_buf, id_buf, sem_p, sem_i):    # scratch: [2,4,T], [2,1,T], (2,), (2,)
     num_pb = p_hbm.shape[0]
     kk = in_d2_ref.shape[-1]
     q = q_ref[0]                             # [S, 3]
@@ -102,7 +102,7 @@ def _kernel(order_ref, boxd2_ref,            # SMEM: [1, 1, Bp] i32 / f32
             start(lax.rem(s + 1, 2), s + 1)
 
         wait(slot, s)
-        p = p_buf[slot]                       # [3, T]
+        p = p_buf[slot]                       # [4, T]; row 3 is tiling pad
         ids = id_buf[slot]                    # [1, T]
         dx = q[:, 0:1] - p[0:1, :]
         dy = q[:, 1:2] - p[1:2, :]
@@ -176,7 +176,7 @@ def _run(order, boxd2, q_pts, q_ids, in_d2, in_idx, p_t, pid_t, *, interpret):
                                              frozenset())),
         ),
         scratch_shapes=[
-            pltpu.VMEM((2, 3, t_p), jnp.float32),
+            pltpu.VMEM((2, p_t.shape[1], t_p), jnp.float32),
             pltpu.VMEM((2, 1, t_p), jnp.int32),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
@@ -206,8 +206,20 @@ def knn_update_tiled_pallas(state: CandidateState, q: BucketedPoints,
     sorted_d2, order = nearest_first_order(q.lower, q.upper,
                                            p.lower, p.upper)  # [Bq, Bp] x2
 
-    p_t = jnp.swapaxes(p.pts, 1, 2)           # [Bp, 3, T]
-    pid_t = p.ids[:, None, :]                 # [Bp, 1, T]
+    # Mosaic DMA-slices p_hbm per bucket, so the sliced dims must match its
+    # VMEM tiling: the coordinate dim rides the sublane axis (tiled in 4s for
+    # a 3-row f32 array — pad to 4, kernel ignores row 3) and the bucket dim
+    # rides the lane axis (tiled in 128s — pad with the same PAD_SENTINEL/-1
+    # rows partition_points uses; their distances overflow to +inf and are
+    # never adopted by the fold)
+    p3 = jnp.swapaxes(p.pts, 1, 2)            # [Bp, 3, T]
+    lane_pad = (-p3.shape[2]) % 128
+    p_t = jnp.pad(p3, ((0, 0), (0, 1), (0, lane_pad)),
+                  constant_values=PAD_SENTINEL)
+    pid = p.ids
+    if lane_pad:
+        pid = jnp.pad(pid, ((0, 0), (0, lane_pad)), constant_values=-1)
+    pid_t = pid[:, None, :]                   # [Bp, 1, T]
 
     assert state.dist2.shape == (num_qb * s_q, k), (state.dist2.shape,
                                                     (num_qb, s_q, k))
