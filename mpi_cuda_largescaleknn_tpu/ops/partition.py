@@ -22,9 +22,11 @@ computation reports as infinitely far — never visited.
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -64,6 +66,46 @@ def choose_buckets(n: int, bucket_size_target: int) -> tuple[int, int]:
     return b, s
 
 
+@functools.partial(jax.jit, static_argnames=("num_buckets", "bucket_size"))
+def _partition_level(x, y, z, ids, pos, num_seg, *, num_buckets, bucket_size):
+    """One median-split level: stable 2-key sort by (segment, split coord).
+
+    ``num_seg`` is a TRACED scalar so all levels share one compiled program.
+    The split dimension is each segment's widest real-point extent; extents
+    are computed shape-uniformly by reducing the static [B, S] fine-bucket
+    grid first and then segment-min/maxing fine buckets into the level's
+    coarser segments (segment boundaries always align with fine buckets
+    because num_seg divides B). Values are identical to a direct
+    [num_seg, seg]-shaped reduction, so the sort keys — and therefore the
+    output, tie order included — are unchanged from the per-level-shape
+    form this replaces.
+    """
+    n_tot = x.shape[0]
+    seg_id = jnp.arange(n_tot, dtype=jnp.int32) // (n_tot // num_seg)
+
+    coords = jnp.stack([x, y, z], axis=1).reshape(num_buckets, bucket_size, 3)
+    valid = coords[:, :, 0:1] < PAD_SENTINEL / 2
+    lo_f = jnp.min(jnp.where(valid, coords, jnp.inf), axis=1)     # [B, 3]
+    hi_f = jnp.max(jnp.where(valid, coords, -jnp.inf), axis=1)
+    seg_of_fine = (jnp.arange(num_buckets, dtype=jnp.int32)
+                   // (num_buckets // num_seg))
+    lo = jax.ops.segment_min(lo_f, seg_of_fine, num_segments=num_buckets)
+    hi = jax.ops.segment_max(hi_f, seg_of_fine, num_segments=num_buckets)
+    ext = hi - lo
+    dim = jnp.argmax(jnp.where(jnp.isfinite(ext), ext, -jnp.inf),
+                     axis=1).astype(jnp.int32)                    # [B]
+    # broadcast, not jnp.repeat: segments are equal-size, and repeat's
+    # general-case lowering builds a constant cumsum whose XLA constant
+    # folding alone cost ~30 s at the 1M-point shape
+    dim_e = jnp.broadcast_to(dim[seg_of_fine][:, None],
+                             (num_buckets, bucket_size)).reshape(-1)
+    key = jnp.where(dim_e == 0, x, jnp.where(dim_e == 1, y, z))
+
+    _, _, x, y, z, ids, pos = lax.sort(
+        (seg_id, key, x, y, z, ids, pos), num_keys=2, is_stable=True)
+    return x, y, z, ids, pos
+
+
 def partition_points(points: jnp.ndarray, point_ids: jnp.ndarray | None = None,
                      *, bucket_size: int = 512) -> BucketedPoints:
     """Partition ``f32[N,3]`` into ``B`` contiguous median-split buckets.
@@ -76,13 +118,37 @@ def partition_points(points: jnp.ndarray, point_ids: jnp.ndarray | None = None,
     """
     points = jnp.asarray(points, jnp.float32)
     n = points.shape[0]
+    num_buckets, bucket_size = choose_buckets(n, bucket_size)
+
+    cols = partition_prep(points, point_ids, num_buckets=num_buckets,
+                          bucket_size=bucket_size)
+    num_levels = int(math.log2(num_buckets))
+    # every level runs the SAME jitted program (_partition_level): segment
+    # granularity rides in as a traced scalar, so XLA compiles ONE sort
+    # pass and the remaining log2(B)-1 levels are cache hits — compiling a
+    # distinct 7-operand million-row sort per level dominated the 1M-point
+    # compile time otherwise. (The reuse only helps when this function runs
+    # OUTSIDE an enclosing jit — inside one, each call inlines into the
+    # trace; parallel/ring.py hoists the partition out for exactly that
+    # reason.)
+    for level in range(num_levels):
+        cols = _partition_level(*cols, jnp.int32(1 << level),
+                                num_buckets=num_buckets,
+                                bucket_size=bucket_size)
+    return partition_finalize(*cols, num_buckets=num_buckets,
+                              bucket_size=bucket_size)
+
+
+def partition_prep(points, point_ids, *, num_buckets, bucket_size):
+    """Stage 1 of the split partition: pad + column-split to the 5 sorted
+    arrays ``(x, y, z, ids, pos)``. ``num_buckets``/``bucket_size`` come
+    from ``choose_buckets``."""
+    points = jnp.asarray(points, jnp.float32)
+    n = points.shape[0]
     if point_ids is None:
         point_ids = jnp.arange(n, dtype=jnp.int32)
     point_ids = jnp.asarray(point_ids, jnp.int32)
-
-    num_buckets, bucket_size = choose_buckets(n, bucket_size)
-    n_tot = num_buckets * bucket_size
-    pad = n_tot - n
+    pad = num_buckets * bucket_size - n
 
     x = jnp.concatenate([points[:, 0], jnp.full((pad,), PAD_SENTINEL, jnp.float32)])
     y = jnp.concatenate([points[:, 1], jnp.full((pad,), PAD_SENTINEL, jnp.float32)])
@@ -90,30 +156,11 @@ def partition_points(points: jnp.ndarray, point_ids: jnp.ndarray | None = None,
     ids = jnp.concatenate([point_ids, jnp.full((pad,), -1, jnp.int32)])
     pos = jnp.concatenate([jnp.arange(n, dtype=jnp.int32),
                            jnp.full((pad,), -1, jnp.int32)])
+    return x, y, z, ids, pos
 
-    num_levels = int(math.log2(num_buckets))
-    for level in range(num_levels):
-        num_seg = 1 << level
-        seg = n_tot // num_seg
-        seg_id = (jnp.arange(n_tot, dtype=jnp.int32) // seg)
 
-        # widest real-point extent per segment picks the split dimension
-        coords = jnp.stack([x, y, z], axis=1).reshape(num_seg, seg, 3)
-        valid = coords[:, :, 0:1] < PAD_SENTINEL / 2
-        lo = jnp.min(jnp.where(valid, coords, jnp.inf), axis=1)    # [seg, 3]
-        hi = jnp.max(jnp.where(valid, coords, -jnp.inf), axis=1)
-        ext = hi - lo
-        dim = jnp.argmax(jnp.where(jnp.isfinite(ext), ext, -jnp.inf),
-                         axis=1).astype(jnp.int32)                 # [num_seg]
-        # broadcast, not jnp.repeat: segments are equal-size, and repeat's
-        # general-case lowering builds a constant cumsum whose XLA constant
-        # folding alone cost ~30 s at the 1M-point shape
-        dim_e = jnp.broadcast_to(dim[:, None], (num_seg, seg)).reshape(-1)
-        key = jnp.where(dim_e == 0, x, jnp.where(dim_e == 1, y, z))
-
-        _, _, x, y, z, ids, pos = lax.sort(
-            (seg_id, key, x, y, z, ids, pos), num_keys=2, is_stable=True)
-
+def partition_finalize(x, y, z, ids, pos, *, num_buckets, bucket_size):
+    """Stage 3: reshape the fully-sorted columns into buckets + AABBs."""
     pts = jnp.stack([x, y, z], axis=1).reshape(num_buckets, bucket_size, 3)
     ids = ids.reshape(num_buckets, bucket_size)
     pos = pos.reshape(num_buckets, bucket_size)

@@ -37,8 +37,9 @@ checkpoint/resume between rounds).
 
 from __future__ import annotations
 
+import math
 import os
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -54,13 +55,59 @@ from mpi_cuda_largescaleknn_tpu.ops.candidates import (
 )
 from mpi_cuda_largescaleknn_tpu.ops.partition import (
     BucketedPoints,
+    _partition_level,
     choose_buckets,
-    partition_points,
+    partition_finalize,
+    partition_prep,
     scatter_back,
 )
 from mpi_cuda_largescaleknn_tpu.ops.tiled import knn_update_tiled
 from mpi_cuda_largescaleknn_tpu.ops.traverse import knn_update_tree
 from mpi_cuda_largescaleknn_tpu.parallel.mesh import AXIS, pvary
+
+
+@lru_cache(maxsize=None)
+def _partition_smaps(mesh, num_buckets, bucket_size):
+    spec = P(AXIS)
+
+    def smap(fn, in_specs, out_specs):
+        # pure-XLA programs: vma checking always on (the engines' pallas
+        # interpret-mode exemption does not apply here)
+        return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                     out_specs=out_specs))
+
+    kw = dict(num_buckets=num_buckets, bucket_size=bucket_size)
+    prep = smap(partial(partition_prep, **kw), (spec, spec), (spec,) * 5)
+    # num_seg rides replicated so every level reuses the ONE compiled sort
+    level = smap(partial(_partition_level, **kw), (spec,) * 5 + (P(),),
+                 (spec,) * 5)
+    fin = smap(partial(partition_finalize, **kw), (spec,) * 5, spec)
+    return prep, level, fin
+
+
+def partition_sharded(points_sharded, ids_sharded, mesh,
+                      bucket_size) -> BucketedPoints:
+    """Per-shard spatial partition, hoisted OUT of the ring's fused jit.
+
+    Equivalent to ``shard_map(partition_points)`` but compiled as one prep
+    program + ONE level program reused for all log2(B) sort passes + one
+    finalize — tracing the partition inside the ring jit instead compiles a
+    distinct million-row 7-operand sort per level, which dominated the
+    1M-point compile time. Returns a BucketedPoints of global sharded
+    arrays (leaf i of shard r at row block r*B_local).
+    """
+    num_shards = mesh.shape[AXIS]
+    npad_local = points_sharded.shape[0] // num_shards
+    b, s = choose_buckets(npad_local, bucket_size)
+    prep, level, fin = _partition_smaps(mesh, b, s)
+
+    sharding = NamedSharding(mesh, P(AXIS))
+    pts = jax.device_put(points_sharded, sharding)
+    ids = jax.device_put(ids_sharded, sharding)
+    cols = prep(pts, ids)
+    for lvl in range(int(math.log2(b))):
+        cols = level(*cols, jnp.int32(1 << lvl))
+    return fin(*cols)
 
 
 def _engine_fn(engine: str, query_tile: int, point_tile: int):
@@ -171,12 +218,17 @@ def _make_ring_fns(k, max_radius, engine, query_tile, point_tile, bucket_size,
     if use_tiled:
         tiled_update = _tiled_engine_fn(engine)
 
-        def query_init_fn(qpts_local, qids_local):
-            q = partition_points(qpts_local, qids_local,
-                                 bucket_size=bucket_size)
-            heap = pvary(init_candidates(q.num_buckets * q.bucket_size, k,
+        def query_from_q(q):
+            # heap init for an ALREADY-partitioned query side (the drivers
+            # hoist the partition out of the jit — see partition_sharded)
+            heap = pvary(init_candidates(q.pts.shape[0] * q.pts.shape[1], k,
                                          max_radius))
             return q, heap
+
+        def init_from_q(q):
+            q, heap = query_from_q(q)
+            shard = (q.pts, q.ids, q.lower, q.upper)
+            return q, (shard, shard), heap
 
         def fold_one(q, shard, heap):
             # the resident shard keeps its OWN bucket geometry (it may differ
@@ -216,19 +268,10 @@ def _make_ring_fns(k, max_radius, engine, query_tile, point_tile, bucket_size,
                                 fill=-1)
             return dists, hd2, hidx
 
-        def shard_init_fn(pts_local, ids_local):
-            # the rotating "tree" = the bucketed shard + its bucket bounds
-            p = partition_points(pts_local, ids_local,
-                                 bucket_size=bucket_size)
-            return (p.pts, p.ids, p.lower, p.upper)
-
-        def init_fn(pts_local, ids_local):
-            # classic path: the same slab is both tree shard and queries
-            # (reference uploads it twice, unorderedDataVariant.cu:159-167);
-            # partition once, derive both sides from it
-            q, heap = query_init_fn(pts_local, ids_local)
-            shard = (q.pts, q.ids, q.lower, q.upper)
-            return q, (shard, shard), heap
+        # the partition itself is hoisted out of the drivers' jits
+        # (partition_sharded), so the in-jit init path only exists in the
+        # *_from_q form — no tiled init_fn/shard_init_fn/query_init_fn
+        init_fn = shard_init_fn = query_init_fn = None
     else:
         update = _engine_fn(engine, query_tile, point_tile)
         use_tree = engine == "tree"
@@ -263,7 +306,10 @@ def _make_ring_fns(k, max_radius, engine, query_tile, point_tile, bucket_size,
             shard = shard_init_fn(pts_local, ids_local)
             return q, (shard, shard), heap
 
-    return init_fn, round_fn, final_fn, shard_init_fn, query_init_fn
+        init_from_q = query_from_q = None  # flat engines have no partition
+
+    return (init_fn, round_fn, final_fn, shard_init_fn, query_init_fn,
+            init_from_q, query_from_q)
 
 
 def _pair_step_fn(round_fn, rotate=True):
@@ -344,14 +390,18 @@ def ring_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
     """
     engine = resolve_engine(engine)
     num_shards = mesh.shape[AXIS]
-    init_fn, round_fn, final_fn, _sif, _qif = _make_ring_fns(
-        k, max_radius, engine, query_tile, point_tile, bucket_size,
-        num_shards)
+    init_fn, round_fn, final_fn, _sif, _qif, init_from_q, _qfq = \
+        _make_ring_fns(k, max_radius, engine, query_tile, point_tile,
+                       bucket_size, num_shards)
 
     total_rounds = ring_total_rounds(num_shards)
+    npad_local = points_sharded.shape[0] // num_shards
 
-    def body(pts_local, ids_local):
-        stationary, pair, heap = init_fn(pts_local, ids_local)
+    def body(pts_local, ids_local, q_local=None):
+        if q_local is not None:
+            stationary, pair, heap = init_from_q(q_local)
+        else:
+            stationary, pair, heap = init_fn(pts_local, ids_local)
 
         def round_body(i, carry):
             pair, hd2, hidx, tiles = carry
@@ -378,21 +428,30 @@ def ring_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
     # interpret-mode pallas kernels re-evaluate a vma-less kernel jaxpr with
     # varying operands, which trips shard_map's vma checker (JAX's own
     # guidance: pass check_vma=False); XLA engines keep the strict typing
+    check_vma = not engine.startswith("pallas")
+    n_args = 3 if init_from_q is not None else 2
     mapped = jax.jit(jax.shard_map(
         body, mesh=mesh,
-        in_specs=(shard_spec, shard_spec),
+        in_specs=(shard_spec,) * n_args,
         out_specs=(shard_spec, shard_spec, shard_spec, shard_spec),
-        check_vma=not engine.startswith("pallas")))
+        check_vma=check_vma))
 
     sharding = NamedSharding(mesh, shard_spec)
     points_sharded = jax.device_put(points_sharded, sharding)
     ids_sharded = jax.device_put(ids_sharded, sharding)
-    dists, hd2, hidx, tiles = mapped(points_sharded, ids_sharded)
+    if init_from_q is not None:
+        # tiled path: the log2(B) partition sort passes compile ONCE outside
+        # the fused program instead of once per level inside it
+        q_parts = partition_sharded(points_sharded, ids_sharded, mesh,
+                                    bucket_size)
+        dists, hd2, hidx, tiles = mapped(points_sharded, ids_sharded,
+                                         q_parts)
+    else:
+        dists, hd2, hidx, tiles = mapped(points_sharded, ids_sharded)
     out = (dists,)
     if return_candidates:
         out += (CandidateState(hd2, hidx),)
     if return_stats:
-        npad_local = points_sharded.shape[0] // num_shards
         out += (_ring_stats(
             engine, int(np.asarray(tiles).sum()), bucket_size,
             num_shards * num_shards * npad_local * npad_local,
@@ -431,9 +490,9 @@ def ring_knn_stepwise(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
 
     engine = resolve_engine(engine)
     num_shards = mesh.shape[AXIS]
-    init_fn, round_fn, final_fn, _sif, _qif = _make_ring_fns(
-        k, max_radius, engine, query_tile, point_tile, bucket_size,
-        num_shards)
+    init_fn, round_fn, final_fn, _sif, _qif, init_from_q, _qfq = \
+        _make_ring_fns(k, max_radius, engine, query_tile, point_tile,
+                       bucket_size, num_shards)
     spec = P(AXIS)
     check_vma = not engine.startswith("pallas")
     npad_local = points_sharded.shape[0] // num_shards
@@ -455,7 +514,13 @@ def ring_knn_stepwise(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
             query_tile=query_tile, point_tile=point_tile, ring="bidir",
             data=ckpt.data_digest(points_sharded, ids_sharded))
 
-    stationary, pair, heap = smap(init_fn, 2, (spec, spec, spec))(pts, ids)
+    if init_from_q is not None:
+        q_parts = partition_sharded(pts, ids, mesh, bucket_size)
+        stationary, pair, heap = smap(init_from_q, 1,
+                                      (spec, spec, spec))(q_parts)
+    else:
+        stationary, pair, heap = smap(init_fn, 2,
+                                      (spec, spec, spec))(pts, ids)
 
     step = smap(_pair_step_fn(round_fn), 5, (spec, spec, spec, spec, spec))
     step_last = smap(_pair_step_fn(round_fn, rotate=False), 5,
@@ -544,9 +609,10 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
 
     engine = resolve_engine(engine)
     num_shards = mesh.shape[AXIS]
-    _init, round_fn, final_fn, shard_init_fn, query_init_fn = _make_ring_fns(
-        k, max_radius, engine, query_tile, point_tile, bucket_size,
-        num_shards)
+    _init, round_fn, final_fn, shard_init_fn, query_init_fn, _ifq, \
+        query_from_q = _make_ring_fns(
+            k, max_radius, engine, query_tile, point_tile, bucket_size,
+            num_shards)
     spec = P(AXIS)
     check_vma = not engine.startswith("pallas")
     sharding = NamedSharding(mesh, spec)
@@ -608,8 +674,20 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
             return rows
         return np.asarray(garr).reshape((num_shards, chunk_rows) + width)
 
-    shard0 = smap(shard_init_fn, 2, spec)(pts_glob, ids_glob)
-    qinit = smap(query_init_fn, 2, (spec, spec))
+    if query_from_q is not None:
+        # tiled: hoisted partitions — ONE compiled sort pass shared by all
+        # levels of the shard partition, another shared by every chunk's
+        # query partition (see partition_sharded)
+        qf = partition_sharded(pts_glob, ids_glob, mesh, bucket_size)
+        shard0 = (qf.pts, qf.ids, qf.lower, qf.upper)
+        _heapq = smap(query_from_q, 1, (spec, spec))
+
+        def qinit(qp_glob, qi_glob):
+            qq = partition_sharded(qp_glob, qi_glob, mesh, bucket_size)
+            return _heapq(qq)
+    else:
+        shard0 = smap(shard_init_fn, 2, spec)(pts_glob, ids_glob)
+        qinit = smap(query_init_fn, 2, (spec, spec))
 
     step = smap(_pair_step_fn(round_fn), 5, (spec, spec, spec, spec, spec))
     step_last = smap(_pair_step_fn(round_fn, rotate=False), 5,

@@ -83,6 +83,28 @@ def test_adoption_across_shards():
     assert_dist_equal(got, kth_nn_dist(pts, pts, k))
 
 
+@pytest.mark.parametrize("visit_batch", [1, 2, 3])
+def test_partial_final_chunk_masks_duplicates(visit_batch):
+    # 5 buckets with V=3 pads the final chunk by duplicating bucket 4: the
+    # duplicate lanes must be masked, or every point of bucket 4 would be
+    # folded twice and displace true candidates
+    pts = random_points(5 * 16, seed=41)
+    k = 6
+    q = partition_points(jnp.asarray(pts), bucket_size=16)
+    assert q.num_buckets == 8  # pow2 bucket count; 3 buckets are all-pad
+    state = init_candidates(q.num_buckets * q.bucket_size, k)
+    state = knn_update_tiled_pallas(state, q, q, visit_batch=visit_batch)
+    d = extract_final_result(state).reshape(q.num_buckets, q.bucket_size)
+    got = np.asarray(scatter_back(d, q.pos, len(pts), fill=jnp.inf))
+    assert_dist_equal(got, kth_nn_dist(pts, pts, k))
+
+
+def test_k100_matches_oracle():
+    pts = random_points(500, seed=43)
+    assert_dist_equal(pallas_self_knn(pts, 100, bucket_size=64),
+                      kth_nn_dist(pts, pts, 100))
+
+
 def test_ring_pallas_tiled_8dev_matches_oracle():
     import jax
 
