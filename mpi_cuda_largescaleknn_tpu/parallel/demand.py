@@ -75,22 +75,37 @@ from mpi_cuda_largescaleknn_tpu.ops.candidates import (
 )
 from mpi_cuda_largescaleknn_tpu.ops.partition import (
     BucketedPoints,
-    partition_points,
     scatter_back,
 )
 from mpi_cuda_largescaleknn_tpu.parallel.mesh import AXIS, pvary
 from mpi_cuda_largescaleknn_tpu.parallel.ring import (
     _engine_fn,
     _tiled_engine_fn,
+    partition_sharded,
     resolve_engine,
     ring_total_rounds,
 )
 
 
+def gathered_bounds_fn(pts_local):
+    """Per-shard AABB of real points, Allgather-ed to every device
+    (the reference's Allgather of 6-float boxes, :290-291). Runs inside
+    shard_map."""
+    valid = pts_local[:, 0] < PAD_SENTINEL / 2
+    box = aabb_of_points(pts_local, valid)
+    all_lower = jax.lax.all_gather(box.lower, AXIS)   # [R, 3]
+    all_upper = jax.lax.all_gather(box.upper, AXIS)
+    return all_lower, all_upper
+
+
 def _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
                      bucket_size, num_shards):
-    """(init_fn, round_fn, final_fn, shard_init_fn, query_init_fn) shared by
-    the fused, stepwise, and chunked demand drivers.
+    """Per-round builders shared by the fused, stepwise, and chunked demand
+    drivers. Returns (init_fn, round_fn, final_fn, shard_init_fn,
+    query_init_fn, init_from_q, query_init_from_q);
+    for tiled engines the first/fourth/fifth are None (the partition is
+    hoisted — use the *_from_q forms with ring.partition_sharded), for flat
+    engines the *_from_q forms are None.
 
     - init_fn(pts_local, ids_local) -> (ctx, shard_state, heap)
       ctx = (stationary queries, replicated box distances, arrival schedule,
@@ -114,38 +129,31 @@ def _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
     bwd = [(i, (i - 1) % num_shards) for i in range(num_shards)]
 
     def shard_init_fn(pts_local, ids_local):
-        valid = pts_local[:, 0] < PAD_SENTINEL / 2
-        if use_tiled:
-            p = partition_points(pts_local, ids_local,
-                                 bucket_size=bucket_size)
-            shard_state = (p.pts, p.ids, p.lower, p.upper)
-        elif use_tree:
+        if use_tree:
             shard_state = build_tree(pts_local, ids_local)
         else:
             shard_state = (pts_local, ids_local)
-        # bounds of every shard's real points, replicated to all devices
-        # (the reference's Allgather of 6-float boxes, :290-291)
-        box = aabb_of_points(pts_local, valid)
-        all_lower = jax.lax.all_gather(box.lower, AXIS)   # [R, 3]
-        all_upper = jax.lax.all_gather(box.upper, AXIS)
-        return shard_state, all_lower, all_upper
+        return (shard_state,) + gathered_bounds_fn(pts_local)
+
+    def query_init_from_q(qpts, q, all_lower, all_upper):
+        # bucketed structures: queries and the rotating shard both carry
+        # per-bucket bounds; the tile-level prune inside the tiled update
+        # subsumes most of the shard-level skip, which remains as a
+        # cheap outer gate
+        heap_rows = q.pts.shape[0] * q.pts.shape[1]
+        heap_valid = (q.ids >= 0).reshape(-1)
+        return _query_ctx(qpts, q, heap_rows, heap_valid,
+                          all_lower, all_upper)
 
     def query_init_fn(qpts, qids, all_lower, all_upper):
+        return _query_ctx(qpts, qpts, qpts.shape[0],
+                          qpts[:, 0] < PAD_SENTINEL / 2,
+                          all_lower, all_upper)
+
+    def _query_ctx(qpts, stationary, heap_rows, heap_valid,
+                   all_lower, all_upper):
         me = jax.lax.axis_index(AXIS)
         valid = qpts[:, 0] < PAD_SENTINEL / 2
-        if use_tiled:
-            # bucketed structures: queries and the rotating shard both carry
-            # per-bucket bounds; the tile-level prune inside the tiled update
-            # subsumes most of the shard-level skip, which remains as a
-            # cheap outer gate
-            q = partition_points(qpts, qids, bucket_size=bucket_size)
-            heap_rows = q.num_buckets * q.bucket_size
-            heap_valid = (q.ids >= 0).reshape(-1)
-            stationary = q
-        else:
-            heap_rows, heap_valid = qpts.shape[0], valid
-            stationary = qpts
-
         # min distance from MY queries' box to every shard's box
         qbox = aabb_of_points(qpts, valid)
         box_dist = aabb_box_distance(qbox.lower[None, :], qbox.upper[None, :],
@@ -159,12 +167,23 @@ def _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
         ctx = (stationary, box_dist, arrival_round, heap_valid)
         return ctx, heap
 
+    def init_from_q(pts_local, q):
+        shard_state = (q.pts, q.ids, q.lower, q.upper)
+        all_lower, all_upper = gathered_bounds_fn(pts_local)
+        ctx, heap = query_init_from_q(pts_local, q, all_lower, all_upper)
+        return ctx, (shard_state, shard_state), heap
+
     def init_fn(pts_local, ids_local):
         shard_state, all_lower, all_upper = shard_init_fn(pts_local,
                                                           ids_local)
         ctx, heap = query_init_fn(pts_local, ids_local, all_lower, all_upper)
         # the rotating "tree" travels twice: forward and backward copies
         return ctx, (shard_state, shard_state), heap
+
+    if use_tiled:
+        init_fn = shard_init_fn = query_init_fn = None
+    else:
+        init_from_q = query_init_from_q = None
 
     def round_fn(ctx, shard_pair, heap, rnd, nrun):
         stationary, box_dist, arrival_round, heap_valid = ctx
@@ -235,7 +254,8 @@ def _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
             return dists, hd2, hidx
         return dists, heap.dist2, heap.idx
 
-    return init_fn, round_fn, final_fn, shard_init_fn, query_init_fn
+    return (init_fn, round_fn, final_fn, shard_init_fn, query_init_fn,
+            init_from_q, query_init_from_q)
 
 
 # one bidirectional-sweep definition for both engines (ring.py)
@@ -258,12 +278,15 @@ def demand_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
     engine = resolve_engine(engine)
     num_shards = mesh.shape[AXIS]
     npad = points_sharded.shape[0] // num_shards
-    init_fn, round_fn, final_fn, _sif, _qif = _make_demand_fns(
-        k, max_radius, engine, query_tile, point_tile, bucket_size,
-        num_shards)
+    init_fn, round_fn, final_fn, _sif, _qif, init_from_q, _qfq = \
+        _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
+                         bucket_size, num_shards)
 
-    def body(pts_local, ids_local):
-        ctx, shard_state, heap = init_fn(pts_local, ids_local)
+    def body(pts_local, ids_local, q_local=None):
+        if q_local is not None:
+            ctx, shard_state, heap = init_from_q(pts_local, q_local)
+        else:
+            ctx, shard_state, heap = init_fn(pts_local, ids_local)
 
         total = demand_total_rounds(num_shards)
 
@@ -288,16 +311,23 @@ def demand_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
         return d, hd2, hidx, pvary(rounds)[None], nrun[None]
 
     spec = P(AXIS)
+    n_args = 3 if init_from_q is not None else 2
     # see ring.py: pallas engines need check_vma=False under shard_map
     mapped = jax.jit(jax.shard_map(
-        body, mesh=mesh, in_specs=(spec, spec),
+        body, mesh=mesh, in_specs=(spec,) * n_args,
         out_specs=(spec, spec, spec, spec, spec),
         check_vma=not engine.startswith("pallas")))
 
     sharding = NamedSharding(mesh, spec)
     points_sharded = jax.device_put(points_sharded, sharding)
     ids_sharded = jax.device_put(ids_sharded, sharding)
-    dists, hd2, hidx, rounds, nrun = mapped(points_sharded, ids_sharded)
+    if init_from_q is not None:
+        q_parts = partition_sharded(points_sharded, ids_sharded, mesh,
+                                    bucket_size)
+        dists, hd2, hidx, rounds, nrun = mapped(points_sharded, ids_sharded,
+                                                q_parts)
+    else:
+        dists, hd2, hidx, rounds, nrun = mapped(points_sharded, ids_sharded)
     if return_stats:
         return dists, CandidateState(hd2, hidx), {
             "rounds": rounds, "kernels_run": nrun}
@@ -332,9 +362,9 @@ def demand_knn_stepwise(points_sharded: jnp.ndarray,
     engine = resolve_engine(engine)
     num_shards = mesh.shape[AXIS]
     npad = points_sharded.shape[0] // num_shards
-    init_fn, round_fn, final_fn, _sif, _qif = _make_demand_fns(
-        k, max_radius, engine, query_tile, point_tile, bucket_size,
-        num_shards)
+    init_fn, round_fn, final_fn, _sif, _qif, init_from_q, _qfq = \
+        _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
+                         bucket_size, num_shards)
     spec = P(AXIS)
     check_vma = not engine.startswith("pallas")
     sharding = NamedSharding(mesh, spec)
@@ -347,7 +377,13 @@ def demand_knn_stepwise(points_sharded: jnp.ndarray,
     pts = jax.device_put(np.asarray(points_sharded, np.float32), sharding)
     ids = jax.device_put(np.asarray(ids_sharded, np.int32), sharding)
 
-    ctx, shard_state, heap = smap(init_fn, 2, (spec, spec, spec))(pts, ids)
+    if init_from_q is not None:
+        q_parts = partition_sharded(pts, ids, mesh, bucket_size)
+        ctx, shard_state, heap = smap(init_from_q, 2,
+                                      (spec, spec, spec))(pts, q_parts)
+    else:
+        ctx, shard_state, heap = smap(init_fn, 2,
+                                      (spec, spec, spec))(pts, ids)
     nrun = jax.device_put(np.zeros(num_shards, np.int32), sharding)
 
     def step_fn(ctx, shard_state, heap, rnd_arr, nrun):
@@ -445,7 +481,8 @@ def demand_knn_chunked(points_sharded: jnp.ndarray,
 
     engine = resolve_engine(engine)
     num_shards = mesh.shape[AXIS]
-    _ifn, round_fn, final_fn, shard_init_fn, query_init_fn = \
+    (_ifn, round_fn, final_fn, shard_init_fn, query_init_fn, _ifq,
+     query_init_from_q) = \
         _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
                          bucket_size, num_shards)
     spec = P(AXIS)
@@ -465,10 +502,22 @@ def demand_knn_chunked(points_sharded: jnp.ndarray,
 
     pts = jax.device_put(points_sharded, sharding)
     ids = jax.device_put(ids_sharded, sharding)
-    shard0, all_lo, all_hi = smap(shard_init_fn, 2, (spec, spec, spec))(
-        pts, ids)
+    if query_init_from_q is not None:
+        # bounds via a tiny smap; shard0 aliases the hoisted partition's
+        # arrays directly instead of round-tripping the whole point set
+        # through a jit for a second device copy
+        q_full = partition_sharded(pts, ids, mesh, bucket_size)
+        all_lo, all_hi = smap(gathered_bounds_fn, 1, (spec, spec))(pts)
+        shard0 = (q_full.pts, q_full.ids, q_full.lower, q_full.upper)
+        _qinit_q = smap(query_init_from_q, 4, (spec, spec))
 
-    qinit = smap(query_init_fn, 4, (spec, spec))
+        def qinit(qp_glob, qi_glob, lo, hi):
+            qq = partition_sharded(qp_glob, qi_glob, mesh, bucket_size)
+            return _qinit_q(qp_glob, qq, lo, hi)
+    else:
+        shard0, all_lo, all_hi = smap(shard_init_fn, 2, (spec, spec, spec))(
+            pts, ids)
+        qinit = smap(query_init_fn, 4, (spec, spec))
 
     def step_fn(ctx, f_state, b_state, heap, rnd_arr, nrun):
         nxt, heap2, rnd2, nrun2, keep_going = round_fn(
