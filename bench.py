@@ -81,9 +81,14 @@ for n in ladder:
     try:
         pts = rng.random((n, 3)).astype(np.float32)
         model = UnorderedKNN(KnnConfig(k=k, engine=eng), mesh=mesh)
+        print("STAGE " + json.dumps({"warmup_start": {"n": n, "engine": eng}}),
+              flush=True)
         t0 = time.perf_counter()
         out = model.run(pts)  # warm the compile cache at full shape
         compile_s = time.perf_counter() - t0
+        print("STAGE " + json.dumps(
+            {"warmup_done": {"n": n, "seconds": round(compile_s, 1)}}),
+            flush=True)
         best, ring_s = float("inf"), None
         for _ in range(reps):
             model.timers.phases.clear()
@@ -142,10 +147,12 @@ for n in ladder:
 
 def _parse_lines(text: str) -> dict:
     got = {"contact": None, "result": None, "failsizes": [],
-           "failengines": []}
+           "failengines": [], "stages": []}
     for line in (text or "").splitlines():
         if line.startswith("CONTACT "):
             got["contact"] = json.loads(line[len("CONTACT "):])
+        elif line.startswith("STAGE "):
+            got["stages"].append(json.loads(line[len("STAGE "):]))
         elif line.startswith("RESULT "):
             got["result"] = json.loads(line[len("RESULT "):])
         elif line.startswith("FAILSIZE "):
@@ -204,6 +211,7 @@ def main() -> int:
             "wall_s": got["wall_s"],
             "failsizes": got["failsizes"],
             "failengines": got["failengines"],
+            "stages": got["stages"],  # attributes a timeout to its phase
         })
         if got["result"] is not None:
             result = got["result"]
@@ -221,7 +229,9 @@ def main() -> int:
         got = _run_child(cpu_ladder, engine, env, remaining)
         probe_log.append({"attempt": "cpu-fallback", "contact": got["contact"],
                           "rc": got["rc"], "wall_s": got["wall_s"],
-                          "failsizes": got["failsizes"]})
+                          "failsizes": got["failsizes"],
+                          "failengines": got["failengines"],
+                          "stages": got["stages"]})
         result = got["result"]
 
     if result is None:
