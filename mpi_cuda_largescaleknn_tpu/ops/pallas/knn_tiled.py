@@ -29,6 +29,7 @@ distance tile + a width-2k sort per visit, here:
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -253,8 +254,13 @@ def knn_update_tiled_pallas(state: CandidateState, q: BucketedPoints,
                                                     (num_qb, s_q, k))
     if visit_batch is None:
         # enough lanes per chunk to amortize the loop step (~2048) without
-        # blowing the VMEM budget on the [S, V*T] distance tile
-        visit_batch = max(1, 2048 // p_t.shape[2])
+        # blowing the VMEM budget on the [S, V*T] distance tile.
+        # LSK_CHUNK_LANES overrides for on-chip tuning — read at TRACE time,
+        # so it must be set before the first run of a process (tpu_tune runs
+        # one fresh subprocess per cell); changing it mid-process is ignored
+        # by the jit cache
+        lanes = int(os.environ.get("LSK_CHUNK_LANES", 2048))
+        visit_batch = max(1, lanes // p_t.shape[2])
     visit_batch = min(visit_batch, p_t.shape[0])
     out_d2, out_idx, visits = _run(order[:, None, :], sorted_d2[:, None, :],
                                    q.pts, q.ids[:, :, None],

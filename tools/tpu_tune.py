@@ -59,6 +59,10 @@ def main() -> int:
         for engine, knobs in [
             ("pallas_tiled", {"bucket_size": 256}),
             ("pallas_tiled", {"bucket_size": 512}),
+            ("pallas_tiled", {"bucket_size": 512,
+                              "env": {"LSK_CHUNK_LANES": "1024"}}),
+            ("pallas_tiled", {"bucket_size": 512,
+                              "env": {"LSK_CHUNK_LANES": "4096"}}),
             ("pallas_tiled", {"bucket_size": 1024}),
             ("tiled", {"bucket_size": 512}),
             ("tiled", {"bucket_size": 1024}),
@@ -76,11 +80,15 @@ def main() -> int:
 
     results = []
     for spec in cells:
+        env = dict(os.environ)
+        # spec["env"] stays in the spec (and the RESULT line) so cells that
+        # differ only by env knobs remain distinguishable in the report
+        env.update(spec.get("env", {}))
         try:
             r = subprocess.run(
                 [sys.executable, "-c", _CHILD, json.dumps(spec)],
                 timeout=float(os.environ.get("TUNE_TIMEOUT_S", 600)),
-                capture_output=True, text=True, env=dict(os.environ))
+                capture_output=True, text=True, env=env)
         except subprocess.TimeoutExpired:
             print(json.dumps({**spec, "error": "timeout"}), flush=True)
             continue
