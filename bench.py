@@ -196,13 +196,17 @@ def main() -> int:
 
     # --- TPU attempts: probe+measure in one process, one retry -------------
     want_tpu = os.environ.get("JAX_PLATFORMS", "") not in ("cpu",)
+    ladder_now = list(ladder)
     for attempt in range(2):
-        if not want_tpu:
+        if not want_tpu or not ladder_now:
             break
         remaining = BUDGET_S - (time.time() - t_start) - CPU_RESERVE_S
         if remaining < 240:  # not enough left for first contact + a run
             break
-        got = _run_child(ladder, engine, dict(os.environ), remaining,
+        # the first attempt may not eat the whole TPU budget: a hang must
+        # leave enough for the retry (which drops the hung rung) to run
+        cap = remaining if attempt == 1 else max(240.0, remaining * 0.55)
+        got = _run_child(ladder_now, engine, dict(os.environ), cap,
                          expect="tpu")
         probe_log.append({
             "attempt": attempt + 1,
@@ -218,6 +222,15 @@ def main() -> int:
             break
         if got["rc"] == 3:  # contacted, but only CPU visible: no point retrying
             break
+        if got["rc"] == "timeout":
+            # the retry must not re-run the rung that hung: the stage lines
+            # name the last rung started; drop it and everything larger.
+            # No stage lines = the hang was first contact, not a rung —
+            # keep the ladder and retry as-is (tunnels recover)
+            started = [s["warmup_start"]["n"] for s in got["stages"]
+                       if "warmup_start" in s]
+            if started:
+                ladder_now = [n for n in ladder_now if n < started[-1]]
 
     # --- CPU fallback, clearly labeled -------------------------------------
     if result is None:
