@@ -96,3 +96,39 @@ def test_measure_exchange_bandwidth_method():
     # round_seconds is a rounded control-subtracted delta: on a contended
     # host it can legitimately round to 0.0 — only its sign is invariant
     assert rep["round_seconds"] >= 0
+
+
+def test_partition_sharded_bit_identical_to_partition_points():
+    """The hoisted per-shard partition (one compiled level program) must be
+    BIT-identical to tracing partition_points per shard — the checkpoint
+    fingerprint and every engine's tie order depend on it."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi_cuda_largescaleknn_tpu.ops.partition import partition_points
+    from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+    from mpi_cuda_largescaleknn_tpu.parallel.ring import partition_sharded
+
+    rng = np.random.default_rng(77)
+    shards = len(jax.devices())
+    npad = 192
+    pts = rng.random((shards * npad, 3)).astype(np.float32)
+    ids = np.arange(shards * npad, dtype=np.int32)
+    q = partition_sharded(pts, ids, get_mesh(shards), 32)
+    b_local = q.pts.shape[0] // shards
+    for r in range(shards):
+        ref = partition_points(jnp.asarray(pts[r * npad:(r + 1) * npad]),
+                               jnp.asarray(ids[r * npad:(r + 1) * npad]),
+                               bucket_size=32)
+        sl = slice(r * b_local, (r + 1) * b_local)
+        np.testing.assert_array_equal(np.asarray(q.pts[sl]),
+                                      np.asarray(ref.pts))
+        np.testing.assert_array_equal(np.asarray(q.ids[sl]),
+                                      np.asarray(ref.ids))
+        np.testing.assert_array_equal(np.asarray(q.pos[sl]),
+                                      np.asarray(ref.pos))
+        np.testing.assert_array_equal(np.asarray(q.lower[sl]),
+                                      np.asarray(ref.lower))
+        np.testing.assert_array_equal(np.asarray(q.upper[sl]),
+                                      np.asarray(ref.upper))
