@@ -92,6 +92,18 @@ else:
     extra["demand_rounds_bidir_bound"] = (
         int((offs * need_mask).max()) + 1 if need_mask.any() else 1)
     extra["needed_peers_per_shard"] = needed.tolist()
+    # per-direction rotation gating (parallel/demand.py): fraction of the
+    # ungated scheme's exchange bytes (2 rotations/round/device) not moved
+    st = model.last_stats or {}
+    rot = np.asarray(st.get("rotations_run") or [])
+    # chunked runs SUM rotations over chunks but report 'rounds' as the
+    # per-chunk max — the ungated-bytes denominator must sum rounds too
+    rounds_den = (sum(st["rounds_per_chunk"]) if st.get("rounds_per_chunk")
+                  else st.get("rounds") or 0)
+    if rot.size and rounds_den:
+        extra["exchange_rotations_run_per_device"] = rot.tolist()
+        extra["exchange_bytes_saved_frac"] = round(
+            1.0 - float(rot.mean()) / (2 * rounds_den), 3)
 
 if shards > 1:
     # MEASURED per-round rotation bandwidth (ppermute minus no-comm
@@ -118,7 +130,13 @@ print("RESULT " + json.dumps({
     "queries_per_sec": round(n / dt, 1),
     "seconds": round(dt, 3),
     "device_seconds": ring.get("seconds"),
-    "exchange_GB_per_sec": ring.get("GB/s", 0.0),
+    # headline exchange figure: the MEASURED per-link rotation bandwidth
+    # (parallel/ring.py measure_exchange_bandwidth) when available; the
+    # phase-timer analytic figure only as a fallback (it reads 0.0 when the
+    # phase timers attribute no bytes to the ring phase)
+    "exchange_GB_per_sec": (
+        extra.get("exchange_measured", {}).get(
+            "exchange_GB_per_sec_per_link") or ring.get("GB/s", 0.0)),
     "stats": getattr(model, "last_stats", None),
     **cr, **extra,
 }), flush=True)

@@ -90,6 +90,12 @@ class PrePartitionedKNN:
                 # (0 when a resumed run had nothing left to do)
                 "rounds": int(rounds.max()) if rounds.size else 0,
                 "kernels_run": np.asarray(stats["kernels_run"]).tolist(),
+                # direction-rotations executed per device (x shard_bytes =
+                # exchange bytes actually moved; the per-direction gating in
+                # parallel/demand.py stops paying for a direction once no
+                # device needs future deliveries from it)
+                "rotations_run": np.asarray(
+                    stats.get("rotations_run", [])).tolist(),
             }
             if cfg.query_chunk > 0:
                 self.last_stats["rounds_per_chunk"] = rounds.tolist()

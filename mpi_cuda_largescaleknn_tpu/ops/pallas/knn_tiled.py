@@ -149,6 +149,26 @@ def _kernel(order_ref, boxd2_ref,            # SMEM: [1, 1, Bp] i32 / f32
     vis_ref[0, 0, 0] = jnp.minimum(c_exit * v_b, num_pb)
 
 
+def _vmem_limit(s_q: int, t_p: int, visit_batch: int, k: int) -> int:
+    """Scoped-VMEM ceiling for the kernel's actual footprint.
+
+    Dominant terms: the [S, V*T] f32 distance tile (plus its jnp.where
+    twins — budget 3x), the double-buffered [2, 4, V*T] f32 + [2, 1, V*T]
+    i32 chunk scratch, and the [S, k] x4 candidate rows. Everything else
+    (query block, SMEM schedules) is noise. Keep the 16MB default whenever
+    it suffices; otherwise pad the computed need by 2x for Mosaic's
+    spills/temporaries, capped at 100MB (v5e physical VMEM is 128MiB).
+    """
+    lanes = visit_batch * t_p
+    need = (3 * s_q * lanes * 4        # distance tile + masked copies
+            + 2 * 5 * lanes * 4        # double-buffered chunk scratch
+            + 4 * s_q * k * 4)         # candidate rows in/out
+    default = 16 * 1024 * 1024
+    if need <= default // 2:           # 2x headroom inside the default
+        return default
+    return min(max(2 * need, default), 100 * 1024 * 1024)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret", "visit_batch"))
 def _run(order, boxd2, q_pts, q_ids, in_d2, in_idx, p_t, pid_t, *, interpret,
          visit_batch):
@@ -207,10 +227,12 @@ def _run(order, boxd2, q_pts, q_ids, in_d2, in_idx, p_t, pid_t, *, interpret,
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",),
-            # the [S, V*T] chunk tiles put ~19MB on the VMEM stack at the 1M
-            # config; the default scoped limit is 16MB but a v5e has 128MiB
-            # physical VMEM — raise the ceiling rather than shrink the chunk
-            vmem_limit_bytes=100 * 1024 * 1024),
+            # the [S, V*T] distance tile + double-buffered chunk scratch put
+            # ~19MB on the VMEM stack at the 1M config, beyond the 16MB
+            # default scoped limit — raise the ceiling (v5e has 128MiB
+            # physical VMEM) ONLY when the computed footprint needs it, so
+            # small shapes and non-v5e parts keep the default guardrail
+            vmem_limit_bytes=_vmem_limit(s_q, t_p, visit_batch, k)),
         interpret=interpret,
     )(order, boxd2, q_pts, q_ids, in_d2, in_idx, p_t, pid_t)
     return out_d2, out_idx, visits

@@ -46,6 +46,12 @@ which IS nearest-first in shard-index space, the right proxy when
 partitions are spatially sorted — runs until the *slowest* device is done,
 pays only a skipped-kernel's cost (~0) for unneeded shards, and keeps every
 transfer on neighbor ICI links instead of arbitrary point-to-point routes.
+The per-rank stop the reference gets for free (:315-322) is recovered at
+direction granularity: each counter-rotating copy's ``ppermute`` is gated
+off (``lax.cond``) once no device needs a future delivery from that
+direction, so tail rounds — including the otherwise-discarded final
+rotation — stop paying exchange bytes (``rotations_run`` in the stats
+measures exactly what was paid).
 Visiting two peers per round, it can finish in ceil(max_needed/2)+1 rounds
 where the reference's one-tree-per-round matching needs max_needed+1.
 
@@ -115,10 +121,14 @@ def _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
     - query_init_fn(qpts, qids, all_lo, all_hi) -> (ctx, heap)
       (query side only — may be a chunk of the slab; its prune distances
       use the CHUNK's own box, which is tighter than the slab's)
-    - round_fn(ctx, shard_state, heap, rnd, nrun)
-        -> (next_shard, new_heap, rnd+1, nrun', keep_going)
-      keep_going is replicated (pmax) — usable as a while_loop predicate on
-      device or read on the host by the stepwise driver.
+    - round_fn(ctx, shard_state, heap, rnd, counts)
+        -> (next_shard, new_heap, rnd+1, counts', keep_going)
+      counts is a per-device i32[2]: [query kernels run, direction-rotations
+      run] — the second times shard_bytes is the exchange traffic actually
+      paid, since each direction's ppermute is gated off once no device
+      needs future deliveries from it. keep_going is replicated (pmax) —
+      usable as a while_loop predicate on device or read on the host by the
+      stepwise driver.
     - final_fn(ctx, heap) -> (dists, hd2, hidx) in input-row order.
     """
     use_tiled = engine in ("tiled", "auto", "pallas_tiled")
@@ -185,14 +195,43 @@ def _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
     else:
         init_from_q = query_init_from_q = None
 
-    def round_fn(ctx, shard_pair, heap, rnd, nrun):
+    def round_fn(ctx, shard_pair, heap, rnd, counts):
         stationary, box_dist, arrival_round, heap_valid = ctx
         me = jax.lax.axis_index(AXIS)
         f_state, b_state = shard_pair
-        nxt = (jax.tree.map(lambda a: jax.lax.ppermute(a, AXIS, fwd),
-                            f_state),
-               jax.tree.map(lambda a: jax.lax.ppermute(a, AXIS, bwd),
-                            b_state))
+        total = ring_total_rounds(num_shards)
+
+        # Per-direction rotation gating (the per-rank stop semantics of
+        # prePartitionedDataVariant.cu:315-322, recovered at direction
+        # granularity): each direction's ppermute runs only while SOME device
+        # still needs a FUTURE delivery from it. The need test uses the
+        # ROUND-ENTRY radius — no new fold result, so XLA can still overlap
+        # the rotation with this round's kernels — and radii only shrink, so
+        # a False is sticky: skipping the rotation can never starve a later
+        # visit (the visit gate below would evaluate False for those arrivals
+        # anyway). Forward delivers offsets 1..R//2 (rounds < total);
+        # backward the same except the dup round (even R) is forward-only.
+        idx = jnp.arange(num_shards)
+        off_f = jnp.mod(me - idx, num_shards)   # fwd copy of s arrives then
+        off_b = jnp.mod(idx - me, num_shards)
+        cur_radius = current_worst_radius(heap, heap_valid)
+        bwd_total = total - 1 if num_shards % 2 == 0 else total
+        # one pmax for both direction bits: two sequential scalar
+        # collectives here would sit on the critical path ahead of the
+        # very rotations the gate exists to cheapen
+        need = jax.lax.pmax(jnp.stack([
+            jnp.any((off_f > rnd) & (off_f < total)
+                    & (box_dist < cur_radius)),
+            jnp.any((off_b > rnd) & (off_b < bwd_total)
+                    & (box_dist < cur_radius))]).astype(jnp.int32), AXIS)
+        need_f, need_b = need[0] > 0, need[1] > 0
+
+        def rot(perm):
+            return lambda s: jax.tree.map(
+                lambda a: jax.lax.ppermute(a, AXIS, perm), s)
+
+        nxt = (jax.lax.cond(need_f, rot(fwd), lambda s: s, f_state),
+               jax.lax.cond(need_b, rot(bwd), lambda s: s, b_state))
 
         src_f = jnp.mod(me - rnd, num_shards)
         src_b = jnp.mod(me + rnd, num_shards)
@@ -213,7 +252,6 @@ def _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
         # round 0 is the own shard at distance 0. The forward visit
         # tightens the radius before the backward visit is decided — the
         # same greedy tightening the reference gets from nearest-first.
-        cur_radius = current_worst_radius(heap, heap_valid)
         visit_f = jax.lax.dynamic_index_in_dim(
             box_dist, src_f, keepdims=False) < cur_radius
         hd2, hidx = jax.lax.cond(visit_f, lambda _: run(f_state, heap),
@@ -226,13 +264,18 @@ def _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
         hd2, hidx = jax.lax.cond(visit_b, lambda _: run(b_state, heap1),
                                  lambda _: (heap1.dist2, heap1.idx), None)
         new_heap = CandidateState(hd2, hidx)
-        nrun = nrun + visit_f.astype(jnp.int32) + visit_b.astype(jnp.int32)
+        # counts = [kernels run, direction-rotations run] per device; the
+        # second measures the bytes actually moved (x shard_bytes) so the
+        # gating's savings are a reported stat, not a claim
+        counts = counts + jnp.stack(
+            [visit_f.astype(jnp.int32) + visit_b.astype(jnp.int32),
+             need_f.astype(jnp.int32) + need_b.astype(jnp.int32)])
 
         # global early exit: does ANY device still need ANY unseen shard?
         new_radius = current_worst_radius(new_heap, heap_valid)
         i_need_more = jnp.any((arrival_round > rnd) & (box_dist < new_radius))
         keep_going = jax.lax.pmax(i_need_more.astype(jnp.int32), AXIS) > 0
-        return nxt, new_heap, rnd + 1, nrun, keep_going
+        return nxt, new_heap, rnd + 1, counts, keep_going
 
     def final_fn(ctx, heap):
         stationary, _box, _arr, _hv = ctx
@@ -295,20 +338,21 @@ def demand_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
             return (rnd < total) & keep_going
 
         def loop_body(carry):
-            shard_state, hd2, hidx, rnd, _kg, nrun = carry
-            nxt, heap2, rnd2, nrun2, keep_going = round_fn(
-                ctx, shard_state, CandidateState(hd2, hidx), rnd, nrun)
-            return nxt, heap2.dist2, heap2.idx, rnd2, keep_going, nrun2
+            shard_state, hd2, hidx, rnd, _kg, counts = carry
+            nxt, heap2, rnd2, counts2, keep_going = round_fn(
+                ctx, shard_state, CandidateState(hd2, hidx), rnd, counts)
+            return nxt, heap2.dist2, heap2.idx, rnd2, keep_going, counts2
 
         # rnd and keep_going are uniform across devices (keep_going is a pmax
-        # reduction, hence replicated); nrun is per-device
+        # reduction, hence replicated); counts is per-device
         init = (shard_state, heap.dist2, heap.idx,
-                jnp.int32(0), jnp.bool_(True), pvary(jnp.int32(0)))
-        _, hd2, hidx, rounds, _, nrun = jax.lax.while_loop(
+                jnp.int32(0), jnp.bool_(True),
+                pvary(jnp.zeros(2, jnp.int32)))
+        _, hd2, hidx, rounds, _, counts = jax.lax.while_loop(
             cond, loop_body, init)
         d, hd2, hidx = final_fn(ctx, CandidateState(hd2, hidx))
         d, hd2, hidx = _trim_rows(d, hd2, hidx, npad)
-        return d, hd2, hidx, pvary(rounds)[None], nrun[None]
+        return d, hd2, hidx, pvary(rounds)[None], counts[None]
 
     spec = P(AXIS)
     n_args = 3 if init_from_q is not None else 2
@@ -324,13 +368,15 @@ def demand_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
     if init_from_q is not None:
         q_parts = partition_sharded(points_sharded, ids_sharded, mesh,
                                     bucket_size)
-        dists, hd2, hidx, rounds, nrun = mapped(points_sharded, ids_sharded,
-                                                q_parts)
+        dists, hd2, hidx, rounds, counts = mapped(points_sharded, ids_sharded,
+                                                  q_parts)
     else:
-        dists, hd2, hidx, rounds, nrun = mapped(points_sharded, ids_sharded)
+        dists, hd2, hidx, rounds, counts = mapped(points_sharded, ids_sharded)
     if return_stats:
+        counts = np.asarray(counts)                   # [R, 2]
         return dists, CandidateState(hd2, hidx), {
-            "rounds": rounds, "kernels_run": nrun}
+            "rounds": rounds, "kernels_run": counts[:, 0],
+            "rotations_run": counts[:, 1]}
     return dists
 
 
@@ -384,14 +430,14 @@ def demand_knn_stepwise(points_sharded: jnp.ndarray,
     else:
         ctx, shard_state, heap = smap(init_fn, 2,
                                       (spec, spec, spec))(pts, ids)
-    nrun = jax.device_put(np.zeros(num_shards, np.int32), sharding)
+    nrun = jax.device_put(np.zeros((num_shards, 2), np.int32), sharding)
 
     def step_fn(ctx, shard_state, heap, rnd_arr, nrun):
         # rnd rides as a per-device [1] array so every input is sharded;
         # keep_going comes back the same way (replicated by construction)
-        nxt, heap2, rnd2, nrun2, keep_going = round_fn(
+        nxt, heap2, rnd2, counts2, keep_going = round_fn(
             ctx, shard_state, heap, rnd_arr[0], nrun[0])
-        return (nxt, heap2, rnd2[None], nrun2[None],
+        return (nxt, heap2, rnd2[None], counts2[None],
                 keep_going.astype(jnp.int32)[None])
 
     step = smap(step_fn, 5, (spec, spec, spec, spec, spec))
@@ -403,7 +449,10 @@ def demand_knn_stepwise(points_sharded: jnp.ndarray,
             n=int(pts.shape[0]), k=int(k), shards=num_shards, engine=engine,
             max_radius=float(max_radius), bucket_size=bucket_size,
             query_tile=query_tile, point_tile=point_tile,
-            kind="demand-bidir", data=ckpt.data_digest(points_sharded, ids_sharded))
+            # -rg: counts carry [kernels, rotations] — older single-counter
+            # checkpoints must not resume into the new shape
+            kind="demand-bidir-rg",
+            data=ckpt.data_digest(points_sharded, ids_sharded))
         got = ckpt.load_pytree(checkpoint_dir, fp,
                                (shard_state, heap, nrun), sharding)
         if got is not None:
@@ -443,10 +492,12 @@ def demand_knn_stepwise(points_sharded: jnp.ndarray,
     if checkpoint_dir and completed:
         ckpt.clear(checkpoint_dir)
     if return_stats:
+        counts = np.asarray(nrun)                     # [R, 2]
         return (np.asarray(d), CandidateState(np.asarray(hd2),
                                               np.asarray(hidx)),
                 {"rounds": np.full(num_shards, rounds_done),
-                 "kernels_run": np.asarray(nrun)})
+                 "kernels_run": counts[:, 0],
+                 "rotations_run": counts[:, 1]})
     return np.asarray(d)
 
 
@@ -520,9 +571,9 @@ def demand_knn_chunked(points_sharded: jnp.ndarray,
         qinit = smap(query_init_fn, 4, (spec, spec))
 
     def step_fn(ctx, f_state, b_state, heap, rnd_arr, nrun):
-        nxt, heap2, rnd2, nrun2, keep_going = round_fn(
+        nxt, heap2, rnd2, counts2, keep_going = round_fn(
             ctx, (f_state, b_state), heap, rnd_arr[0], nrun[0])
-        return (nxt[0], nxt[1], heap2, rnd2[None], nrun2[None],
+        return (nxt[0], nxt[1], heap2, rnd2[None], counts2[None],
                 keep_going.astype(jnp.int32)[None])
 
     step = smap(step_fn, 6, (spec,) * 6)
@@ -539,7 +590,7 @@ def demand_knn_chunked(points_sharded: jnp.ndarray,
     out_idx = (np.full((num_shards, npad, k), -1, np.int32)
                if return_candidates else None)
     rounds_per_chunk: list[int] = []
-    nrun_total = np.zeros(num_shards, np.int64)
+    nrun_total = np.zeros((num_shards, 2), np.int64)
 
     fp = None
     start_chunk = 0
@@ -549,7 +600,7 @@ def demand_knn_chunked(points_sharded: jnp.ndarray,
             engine=engine, max_radius=float(max_radius),
             bucket_size=bucket_size, chunk_rows=chunk_rows,
             query_tile=query_tile, point_tile=point_tile,
-            kind="demand-chunked", candidates=bool(return_candidates),
+            kind="demand-chunked-rg", candidates=bool(return_candidates),
             data=ckpt.data_digest(points_sharded, ids_sharded))
         got = ckpt.load_ring_state(checkpoint_dir, fp)
         if got is not None:
@@ -573,7 +624,7 @@ def demand_knn_chunked(points_sharded: jnp.ndarray,
         # pristine pair each chunk: the resident original never rotates
         f_state, b_state = shard0, shard0
         rnd_arr = jax.device_put(np.zeros(num_shards, np.int32), sharding)
-        nrun = jax.device_put(np.zeros(num_shards, np.int32), sharding)
+        nrun = jax.device_put(np.zeros((num_shards, 2), np.int32), sharding)
         rounds = 0
         while rounds < total_rounds:
             f_state, b_state, heap, rnd_arr, nrun, kg = step(
@@ -613,5 +664,6 @@ def demand_knn_chunked(points_sharded: jnp.ndarray,
     if return_stats:
         return dists, cands, {
             "rounds": np.asarray(rounds_per_chunk),
-            "kernels_run": nrun_total}
+            "kernels_run": nrun_total[:, 0],
+            "rotations_run": nrun_total[:, 1]}
     return dists

@@ -66,8 +66,9 @@ from mpi_cuda_largescaleknn_tpu.ops.traverse import knn_update_tree
 from mpi_cuda_largescaleknn_tpu.parallel.mesh import AXIS, pvary
 
 
-@lru_cache(maxsize=None)
-def _partition_smaps(mesh, num_buckets, bucket_size):
+@lru_cache(maxsize=32)  # bounded: chunked drivers with varying chunk shapes
+def _partition_smaps(mesh, num_buckets, bucket_size):  # or fresh Mesh objects
+    # must not pin compiled programs + device refs forever
     spec = P(AXIS)
 
     def smap(fn, in_specs, out_specs):
@@ -350,7 +351,16 @@ def _ring_stats(engine: str, tiles_total: int, bucket_size: int,
     per-device query/point row counts the buckets were built from. Flat
     engines score every pair, so the count is analytic:
     ``n_q_device_rounds`` = sum over device-rounds of
-    n_queries_local * n_points_local."""
+    n_queries_local * n_points_local.
+
+    Granularity caveat: the visit-batched Pallas kernel
+    (ops/pallas/knn_tiled.py) DMAs and scores V buckets per while step, so
+    its tile count — and the pair_evals/MFU derived here — is at CHUNK
+    granularity: up to V-1 buckets beyond the prune radius in a started
+    chunk are included. That is the honest count of work *executed* (those
+    lanes really are scored), but it is not comparable with the per-visit
+    kernel's or the XLA twin's per-bucket counts as a measure of pruning
+    quality; compare engines on wall-clock, not pair_evals."""
     use_tiled = engine in ("tiled", "auto", "pallas_tiled")
     if use_tiled:
         _, s_q = choose_buckets(q_rows or 1, bucket_size)

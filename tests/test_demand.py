@@ -53,6 +53,31 @@ def test_demand_early_exit_on_tiled_data():
         assert_dist_equal(d, kth_nn_dist(part, allp, 4))
 
 
+def test_demand_rotation_gating_saves_bytes():
+    # adjacent-overlap clusters (cluster i spans [0.85*i, 0.85*i + 1] in x):
+    # every shard needs exactly its +-1 ring neighbors, nothing further —
+    # the offset-2 box distance (0.7) clears even a corner query's
+    # own-shard-only k-th radius (~0.45), so the gate's entry-radius
+    # over-approximation still rules those arrivals out at round 1's entry.
+    # Round 0 rotates both directions (entry radius is inf); at round 1 no
+    # device needs any delivery beyond the copies already in flight, so
+    # BOTH ppermutes are gated off — the ungated scheme would pay
+    # 2 rotations/round/device = 4; the gated ring pays 2. Results must be
+    # identical to the oracle (gating must never starve a visit).
+    parts = _tiled_partitions(8, 200, gap=0.85, seed=60)
+    model = PrePartitionedKNN(_cfg(), mesh=get_mesh(8))
+    got = model.run(parts)
+    st = model.last_stats
+    assert st["rounds"] == 2, st
+    assert st["rotations_run"] == [2] * 8, st
+    # interior shards visit own + both neighbors; edge shards skip the
+    # wrapped far neighbor
+    assert all(2 <= n <= 3 for n in st["kernels_run"]), st
+    allp = np.concatenate(parts)
+    for part, d in zip(parts, got):
+        assert_dist_equal(d, kth_nn_dist(part, allp, 8))
+
+
 def test_demand_uneven_and_empty_partitions():
     parts = [random_points(50, seed=20), np.zeros((0, 3), np.float32),
              random_points(75, seed=21), random_points(10, seed=22)]
