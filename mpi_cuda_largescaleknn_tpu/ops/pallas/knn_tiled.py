@@ -48,6 +48,7 @@ from mpi_cuda_largescaleknn_tpu.ops.partition import (
 
 
 def _kernel(order_ref, boxd2_ref,            # SMEM: [1, 1, Bp] i32 / f32
+            sskip_ref,                       # SMEM: [1, 1, 1] i32 skip-self
             q_ref, qid_ref,                  # VMEM: [1, S, 3] / [1, S, 1]
             in_d2_ref, in_idx_ref,           # VMEM: [S, k]
             p_hbm, pid_hbm,                  # ANY (HBM): [Bp, 4, T] / [Bp, 1, T]
@@ -100,9 +101,13 @@ def _kernel(order_ref, boxd2_ref,            # SMEM: [1, 1, Bp] i32 / f32
 
     start_chunk(0, 0)
     lane = lax.broadcasted_iota(jnp.int32, (1, v_b * t_p), 1)
+    # read once at kernel scope: program_id inside the while body does not
+    # lower under the CPU interpreter's HLO path
+    b_cur = pl.program_id(0)
+    sskip = sskip_ref[0, 0, 0] != 0
 
     def cond(carry):
-        c, cd2, _cidx = carry
+        c, cd2, _cidx, _nv = carry
         # nearest-first order is ascending in box distance, so if even the
         # chunk's FIRST bucket is beyond every query's radius, all later
         # buckets are too. & does not short-circuit in traced code: clamp
@@ -111,7 +116,7 @@ def _kernel(order_ref, boxd2_ref,            # SMEM: [1, 1, Bp] i32 / f32
         return (c < num_chunks) & (boxd2_ref[0, 0, first] < worst2(cd2))
 
     def body(carry):
-        c, cd2, cidx = carry
+        c, cd2, cidx, nvis = carry
         slot = lax.rem(c, 2)
 
         @pl.when(c + 1 < num_chunks)
@@ -125,16 +130,38 @@ def _kernel(order_ref, boxd2_ref,            # SMEM: [1, 1, Bp] i32 / f32
         dy = q[:, 1:2] - p[1:2, :]
         dz = q[:, 2:3] - p[2:3, :]
         d2 = (dx * dx + dy * dy) + dz * dz    # [S, V*T]
+        # per-VISIT pruning inside the chunk (the per-node prune of
+        # cukd::stackFree::knn, unorderedDataVariant.cu:86, recovered at
+        # bucket granularity): a bucket whose box distance is at or beyond
+        # the chunk-entry worst radius cannot be adopted by ANY query row
+        # (point dist >= box dist >= every row's k-th), so its lanes go to
+        # +inf. The distance broadcast still covers them — what this buys
+        # is fewer fold extract-min passes (masked lanes never improve a
+        # row) and a visits count at true per-bucket granularity. The same
+        # mask drops the query bucket's OWN bucket when the heap was
+        # pre-filled by warm_start_self (sskip nonzero): re-folding it
+        # would adopt every self point twice.
+        worst_c = worst2(cd2)
+        s_idxs = [jnp.minimum(c * v_b + v, num_pb - 1) for v in range(v_b)]
+        keep_v = [(boxd2_ref[0, 0, si] < worst_c)
+                  & ~((order_ref[0, 0, si] == b_cur) & sskip)
+                  for si in s_idxs]           # static unroll, SMEM scalars
         # the last chunk may be padded with duplicates of bucket num_pb-1:
-        # folding a point twice would corrupt the candidate list, so mask
-        # the duplicate lanes to +inf (strict-< insert never adopts them)
+        # folding a point twice would corrupt the candidate list, so those
+        # lanes are masked unconditionally (strict-< never adopts +inf)
         n_valid = (jnp.minimum(num_pb - c * v_b, v_b)) * t_p
-        d2 = jnp.where(lane < n_valid, d2, jnp.inf)
+        keep_lane = jnp.concatenate(
+            [jnp.full((1, t_p), kv, jnp.bool_) for kv in keep_v], axis=1)
+        keep = keep_lane & (lane < n_valid)
+        d2 = jnp.where(keep, d2, jnp.inf)
         cd2, cidx = fold_tile_into_candidates(d2, ids, cd2, cidx)
-        return c + 1, cd2, cidx
+        nvis = nvis + sum((kv & (c * v_b + v < num_pb)).astype(jnp.int32)
+                          for v, kv in enumerate(keep_v))
+        return c + 1, cd2, cidx, nvis
 
-    c_exit, cd2, cidx = lax.while_loop(
-        cond, body, (jnp.int32(0), in_d2_ref[:], in_idx_ref[:]))
+    c_exit, cd2, cidx, nvis = lax.while_loop(
+        cond, body, (jnp.int32(0), in_d2_ref[:], in_idx_ref[:],
+                     jnp.int32(0)))
 
     # a prefetch for chunk c_exit is in flight whenever the loop stopped
     # short of the end (started initially for c=0 or by the body for c+1);
@@ -145,8 +172,10 @@ def _kernel(order_ref, boxd2_ref,            # SMEM: [1, 1, Bp] i32 / f32
 
     out_d2_ref[:] = cd2
     out_idx_ref[:] = cidx
-    # buckets this query bucket actually scored (pad duplicates excluded)
-    vis_ref[0, 0, 0] = jnp.minimum(c_exit * v_b, num_pb)
+    # buckets this query bucket actually scored (per-visit precision:
+    # chunk-tail buckets beyond the entry radius and pad duplicates are
+    # masked before the fold and excluded here)
+    vis_ref[0, 0, 0] = nvis
 
 
 def _vmem_limit(s_q: int, t_p: int, visit_batch: int, k: int) -> int:
@@ -170,8 +199,8 @@ def _vmem_limit(s_q: int, t_p: int, visit_batch: int, k: int) -> int:
 
 
 @functools.partial(jax.jit, static_argnames=("interpret", "visit_batch"))
-def _run(order, boxd2, q_pts, q_ids, in_d2, in_idx, p_t, pid_t, *, interpret,
-         visit_batch):
+def _run(order, boxd2, sskip, q_pts, q_ids, in_d2, in_idx, p_t, pid_t, *,
+         interpret, visit_batch):
     num_qb, s_q, _one = q_ids.shape
     num_pb, _, t_p = p_t.shape
     k = in_d2.shape[-1]
@@ -186,6 +215,8 @@ def _run(order, boxd2, q_pts, q_ids, in_d2, in_idx, p_t, pid_t, *, interpret,
             pl.BlockSpec((1, 1, num_pb), lambda b: (b, 0, 0),
                          memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1, num_pb), lambda b: (b, 0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, 1), lambda b: (0, 0, 0),
                          memory_space=pltpu.SMEM),
             pl.BlockSpec((1, s_q, 3), lambda b: (b, 0, 0),
                          memory_space=pltpu.VMEM),
@@ -234,7 +265,7 @@ def _run(order, boxd2, q_pts, q_ids, in_d2, in_idx, p_t, pid_t, *, interpret,
             # small shapes and non-v5e parts keep the default guardrail
             vmem_limit_bytes=_vmem_limit(s_q, t_p, visit_batch, k)),
         interpret=interpret,
-    )(order, boxd2, q_pts, q_ids, in_d2, in_idx, p_t, pid_t)
+    )(order, boxd2, sskip, q_pts, q_ids, in_d2, in_idx, p_t, pid_t)
     return out_d2, out_idx, visits
 
 
@@ -242,12 +273,15 @@ def knn_update_tiled_pallas(state: CandidateState, q: BucketedPoints,
                             p: BucketedPoints, *,
                             interpret: bool | None = None,
                             with_stats: bool = False,
-                            visit_batch: int | None = None):
+                            visit_batch: int | None = None,
+                            skip_self=None):
     """Drop-in Pallas twin of ``ops.tiled.knn_update_tiled`` (same contract:
     state rows in ``q``'s bucket order; folds every real point of ``p`` in;
     ``with_stats`` additionally returns the i32 count of [S, T] tiles
     scored — here the sum over query buckets of buckets each visited, since
-    every bucket advances independently instead of lock-stepping)."""
+    every bucket advances independently instead of lock-stepping;
+    ``skip_self`` as in the twin: nonzero masks point bucket b out of query
+    bucket b's traversal for warm-started self-joins)."""
     if interpret is None:
         from mpi_cuda_largescaleknn_tpu.ops.pallas import is_tpu_backend
         interpret = not is_tpu_backend()
@@ -284,8 +318,10 @@ def knn_update_tiled_pallas(state: CandidateState, q: BucketedPoints,
         lanes = int(os.environ.get("LSK_CHUNK_LANES", 2048))
         visit_batch = max(1, lanes // p_t.shape[2])
     visit_batch = min(visit_batch, p_t.shape[0])
+    ss = jnp.asarray(0 if skip_self is None else skip_self,
+                     jnp.int32).reshape(1, 1, 1)
     out_d2, out_idx, visits = _run(order[:, None, :], sorted_d2[:, None, :],
-                                   q.pts, q.ids[:, :, None],
+                                   ss, q.pts, q.ids[:, :, None],
                                    state.dist2, state.idx, p_t, pid_t,
                                    interpret=interpret,
                                    visit_batch=visit_batch)

@@ -34,7 +34,10 @@ import jax.numpy as jnp
 from jax import lax
 
 from mpi_cuda_largescaleknn_tpu.core.types import CandidateState
-from mpi_cuda_largescaleknn_tpu.ops.candidates import merge_candidates
+from mpi_cuda_largescaleknn_tpu.ops.candidates import (
+    init_candidates,
+    merge_candidates,
+)
 from mpi_cuda_largescaleknn_tpu.ops.partition import (
     BucketedPoints,
     nearest_first_order,
@@ -62,9 +65,57 @@ def _worst2(hd2: jnp.ndarray, qvalid: jnp.ndarray) -> jnp.ndarray:
     return jnp.max(jnp.where(qvalid, kth, -jnp.inf), axis=1)
 
 
+def warm_start_self(q: BucketedPoints, k: int,
+                    max_radius: float = jnp.inf) -> CandidateState:
+    """Exact top-k of each query's OWN bucket, as the initial candidate
+    state for a self-join traversal.
+
+    The reference's cold heap fills during the first tree descent at no
+    extra cost (one scalar insert per visited node,
+    unorderedDataVariant.cu:86); the tile engines' fold instead pays up to
+    k+1 extract-min passes over the first [S, V*T] chunk while a cold row
+    adopts its first k candidates — ~k full-tile passes per query bucket,
+    the dominant cost at k=100. Pre-folding the self bucket (each query's
+    nearest neighborhood by construction: it shares the query's tight AABB)
+    with one batched ``top_k``+merge fills every row exactly and shrinks
+    the entry radius, so the traversal starts warm. Callers MUST then mask
+    the self bucket out of the traversal (``skip_self``) — folding it twice
+    would corrupt the candidate rows with duplicates.
+
+    Semantics match the fold exactly: strict-< adoption against the
+    ``max_radius`` cutoff slots (merge_candidates' stable existing-first
+    sort), pad lanes carry +inf distance, self counts as neighbor 0.
+    """
+    num_qb, s = q.ids.shape
+    init = init_candidates(num_qb * s, k, max_radius)
+    hd2 = init.dist2.reshape(num_qb, s, k)
+    hidx = init.idx.reshape(num_qb, s, k)
+
+    def one(args):
+        pts, ids, cd2, cidx = args            # [S,3],[S],[S,k],[S,k]
+        dx = pts[:, None, 0] - pts[None, :, 0]
+        dy = pts[:, None, 1] - pts[None, :, 1]
+        dz = pts[:, None, 2] - pts[None, :, 2]
+        d2 = (dx * dx + dy * dy) + dz * dz    # [S, S]
+        # pad lanes: PAD_SENTINEL coords already overflow to +inf, the
+        # mask makes it explicit (and safe against sentinel changes)
+        d2 = jnp.where(ids[None, :] >= 0, d2, jnp.inf)
+        st = merge_candidates(CandidateState(cd2, cidx), d2,
+                              jnp.broadcast_to(ids[None, :], d2.shape))
+        return st.dist2, st.idx
+
+    # sequential over buckets would serialize thousands of small ops (the
+    # round-3 lesson); batch_size vmaps blocks of buckets per map step
+    hd2, hidx = lax.map(one, (q.pts, q.ids, hd2, hidx),
+                        batch_size=min(64, num_qb))
+    return CandidateState(hd2.reshape(num_qb * s, k),
+                          hidx.reshape(num_qb * s, k))
+
+
 def knn_update_tiled(state: CandidateState, q: BucketedPoints,
                      p: BucketedPoints, *, chunk_buckets: int | None = None,
-                     visits_per_step: int = 8, with_stats: bool = False):
+                     visits_per_step: int = 8, with_stats: bool = False,
+                     skip_self=None):
     """Fold every real point of ``p`` into the candidate state (one
     reference ``runQuery`` launch, at bucket granularity).
 
@@ -82,6 +133,11 @@ def knn_update_tiled(state: CandidateState, q: BucketedPoints,
     Round 3 proved the twin's bottleneck was thousands of small serialized
     ops, not arithmetic; V-batching plus the wider chunk budget cuts the
     sequential-op count by ~V * (new_budget / old_budget).
+
+    ``skip_self``: traced i32/bool scalar; when nonzero, point bucket ``b``
+    is never folded into query bucket ``b`` — for self-joins whose heap was
+    pre-filled by ``warm_start_self`` (``q`` and ``p`` must then be the
+    SAME partition, so bucket indices correspond).
     """
     num_qb, s_q = q.ids.shape
     num_pb, s_p = p.ids.shape
@@ -122,6 +178,9 @@ def knn_update_tiled(state: CandidateState, q: BucketedPoints,
         visit = lax.dynamic_slice_in_dim(order, step * v, v, axis=1)
         visit_d2 = lax.dynamic_slice_in_dim(sorted_d2, step * v, v, axis=1)
         active = visit_d2 < worst2[:, None]                      # [Bq, V]
+        if skip_self is not None:
+            self_hit = visit == jnp.arange(num_qb, dtype=visit.dtype)[:, None]
+            active &= ~(self_hit & (jnp.asarray(skip_self) != 0))
         pts_v = p.pts[visit]                                     # [Bq,V,T,3]
         ids_v = p.ids[visit]                                     # [Bq,V,T]
 

@@ -83,6 +83,7 @@ from mpi_cuda_largescaleknn_tpu.ops.partition import (
     BucketedPoints,
     scatter_back,
 )
+from mpi_cuda_largescaleknn_tpu.ops.tiled import warm_start_self
 from mpi_cuda_largescaleknn_tpu.parallel.mesh import AXIS, pvary
 from mpi_cuda_largescaleknn_tpu.parallel.ring import (
     _engine_fn,
@@ -105,7 +106,7 @@ def gathered_bounds_fn(pts_local):
 
 
 def _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
-                     bucket_size, num_shards):
+                     bucket_size, num_shards, warm_start=False):
     """Per-round builders shared by the fused, stepwise, and chunked demand
     drivers. Returns (init_fn, round_fn, final_fn, shard_init_fn,
     query_init_fn, init_from_q, query_init_from_q);
@@ -134,6 +135,9 @@ def _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
     use_tiled = engine in ("tiled", "auto", "pallas_tiled")
     update = None if use_tiled else _engine_fn(engine, query_tile, point_tile)
     tiled_update = _tiled_engine_fn(engine) if use_tiled else None
+    # warm start needs query bucket b == resident bucket b in round 0, i.e.
+    # the self-join init path on one shared partition (see ring.py)
+    warm_start = warm_start and use_tiled
     use_tree = engine == "tree"
     fwd = [(i, (i + 1) % num_shards) for i in range(num_shards)]
     bwd = [(i, (i - 1) % num_shards) for i in range(num_shards)]
@@ -181,6 +185,10 @@ def _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
         shard_state = (q.pts, q.ids, q.lower, q.upper)
         all_lower, all_upper = gathered_bounds_fn(pts_local)
         ctx, heap = query_init_from_q(pts_local, q, all_lower, all_upper)
+        if warm_start:
+            # exact top-k of every query's own bucket (ops/tiled.py);
+            # round 0's own-shard visit then masks the self bucket
+            heap = warm_start_self(q, k, max_radius)
         return ctx, (shard_state, shard_state), heap
 
     def init_fn(pts_local, ids_local):
@@ -237,12 +245,13 @@ def _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
         src_b = jnp.mod(me + rnd, num_shards)
         dup = src_f == src_b  # round 0 (own shard) and round R/2 (R even)
 
-        def run(shard_state, heap):
+        def run(shard_state, heap, sskip=None):
             if use_tiled:
                 resident = BucketedPoints(
                     shard_state[0], shard_state[1], shard_state[2],
                     shard_state[3], shard_state[1])
-                st = tiled_update(heap, stationary, resident)
+                st = tiled_update(heap, stationary, resident,
+                                  skip_self=sskip)
             else:
                 st = update(heap, stationary, *shard_state)
             return st.dist2, st.idx
@@ -254,7 +263,11 @@ def _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
         # same greedy tightening the reference gets from nearest-first.
         visit_f = jax.lax.dynamic_index_in_dim(
             box_dist, src_f, keepdims=False) < cur_radius
-        hd2, hidx = jax.lax.cond(visit_f, lambda _: run(f_state, heap),
+        # round 0's forward arrival is the own shard: with a warm-started
+        # heap its self buckets are already folded and must be masked
+        sskip = ((rnd == 0).astype(jnp.int32) if warm_start else None)
+        hd2, hidx = jax.lax.cond(visit_f,
+                                 lambda _: run(f_state, heap, sskip),
                                  lambda _: (heap.dist2, heap.idx), None)
         heap1 = CandidateState(hd2, hidx)
 
@@ -323,7 +336,7 @@ def demand_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
     npad = points_sharded.shape[0] // num_shards
     init_fn, round_fn, final_fn, _sif, _qif, init_from_q, _qfq = \
         _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
-                         bucket_size, num_shards)
+                         bucket_size, num_shards, warm_start=True)
 
     def body(pts_local, ids_local, q_local=None):
         if q_local is not None:
@@ -408,9 +421,6 @@ def demand_knn_stepwise(points_sharded: jnp.ndarray,
     engine = resolve_engine(engine)
     num_shards = mesh.shape[AXIS]
     npad = points_sharded.shape[0] // num_shards
-    init_fn, round_fn, final_fn, _sif, _qif, init_from_q, _qfq = \
-        _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
-                         bucket_size, num_shards)
     spec = P(AXIS)
     check_vma = not engine.startswith("pallas")
     sharding = NamedSharding(mesh, spec)
@@ -422,6 +432,26 @@ def demand_knn_stepwise(points_sharded: jnp.ndarray,
 
     pts = jax.device_put(np.asarray(points_sharded, np.float32), sharding)
     ids = jax.device_put(np.asarray(ids_sharded, np.int32), sharding)
+
+    fp = None
+    resuming = False
+    if checkpoint_dir:
+        fp = ckpt.fingerprint(
+            n=int(pts.shape[0]), k=int(k), shards=num_shards, engine=engine,
+            max_radius=float(max_radius), bucket_size=bucket_size,
+            query_tile=query_tile, point_tile=point_tile,
+            # -rg: counts carry [kernels, rotations] — older single-counter
+            # checkpoints must not resume into the new shape
+            kind="demand-bidir-rg",
+            data=ckpt.data_digest(points_sharded, ids_sharded))
+        # a resumed run's heap comes from the checkpoint: skip the warm
+        # start's per-bucket top-k work instead of computing and
+        # discarding it (see ring_knn_stepwise)
+        resuming = ckpt.peek_round(checkpoint_dir, fp) is not None
+
+    init_fn, round_fn, final_fn, _sif, _qif, init_from_q, _qfq = \
+        _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
+                         bucket_size, num_shards, warm_start=not resuming)
 
     if init_from_q is not None:
         q_parts = partition_sharded(pts, ids, mesh, bucket_size)
@@ -442,17 +472,8 @@ def demand_knn_stepwise(points_sharded: jnp.ndarray,
 
     step = smap(step_fn, 5, (spec, spec, spec, spec, spec))
 
-    fp = None
     start = 0
     if checkpoint_dir:
-        fp = ckpt.fingerprint(
-            n=int(pts.shape[0]), k=int(k), shards=num_shards, engine=engine,
-            max_radius=float(max_radius), bucket_size=bucket_size,
-            query_tile=query_tile, point_tile=point_tile,
-            # -rg: counts carry [kernels, rotations] — older single-counter
-            # checkpoints must not resume into the new shape
-            kind="demand-bidir-rg",
-            data=ckpt.data_digest(points_sharded, ids_sharded))
         got = ckpt.load_pytree(checkpoint_dir, fp,
                                (shard_state, heap, nrun), sharding)
         if got is not None:

@@ -61,7 +61,10 @@ from mpi_cuda_largescaleknn_tpu.ops.partition import (
     partition_prep,
     scatter_back,
 )
-from mpi_cuda_largescaleknn_tpu.ops.tiled import knn_update_tiled
+from mpi_cuda_largescaleknn_tpu.ops.tiled import (
+    knn_update_tiled,
+    warm_start_self,
+)
 from mpi_cuda_largescaleknn_tpu.ops.traverse import knn_update_tree
 from mpi_cuda_largescaleknn_tpu.parallel.mesh import AXIS, pvary
 
@@ -171,7 +174,7 @@ def _tiled_engine_fn(engine: str):
 
 
 def _make_ring_fns(k, max_radius, engine, query_tile, point_tile, bucket_size,
-                   num_shards):
+                   num_shards, warm_start=False):
     """(init_fn, round_fn, final_fn, shard_init_fn, query_init_fn) — the
     per-round pieces every ring driver executes, defined once so the fused,
     stepwise and chunked paths cannot diverge.
@@ -204,6 +207,10 @@ def _make_ring_fns(k, max_radius, engine, query_tile, point_tile, bucket_size,
     there, keeping every shard folded exactly once.
     """
     use_tiled = engine in ("tiled", "auto", "pallas_tiled")
+    # warm start only applies to self-joins on ONE shared partition (query
+    # bucket b IS point bucket b in round 0) — the tiled drivers; chunked
+    # drivers partition queries separately and must stay cold
+    warm_start = warm_start and use_tiled
     fwd = [(i, (i + 1) % num_shards) for i in range(num_shards)]
     bwd = [(i, (i - 1) % num_shards) for i in range(num_shards)]
 
@@ -227,17 +234,24 @@ def _make_ring_fns(k, max_radius, engine, query_tile, point_tile, bucket_size,
             return q, heap
 
         def init_from_q(q):
-            q, heap = query_from_q(q)
+            if warm_start:
+                # exact top-k of every query's own bucket, folded before
+                # the traversal (ops/tiled.py warm_start_self) — round 0's
+                # kernel then masks the self bucket (skip_self below)
+                heap = warm_start_self(q, k, max_radius)
+            else:
+                q, heap = query_from_q(q)
             shard = (q.pts, q.ids, q.lower, q.upper)
             return q, (shard, shard), heap
 
-        def fold_one(q, shard, heap):
+        def fold_one(q, shard, heap, sskip=None):
             # the resident shard keeps its OWN bucket geometry (it may differ
             # from the query side's under chunked queries); pos is
             # query-side-only metadata, ids stand in for it
             resident = BucketedPoints(shard[0], shard[1], shard[2], shard[3],
                                       shard[1])
-            return tiled_update(heap, q, resident, with_stats=True)
+            return tiled_update(heap, q, resident, with_stats=True,
+                                skip_self=sskip)
 
         def round_fn(q, shard_pair, heap, rnd, rotate=True):
             # the final round's rotation would be discarded — callers pass
@@ -245,7 +259,10 @@ def _make_ring_fns(k, max_radius, engine, query_tile, point_tile, bucket_size,
             # under a traced cond)
             nxt = rotate_pair(shard_pair) if rotate else shard_pair
             f, b = shard_pair
-            st, tiles_f = fold_one(q, f, heap)
+            # round 0's forward fold is the own shard: with a warm-started
+            # heap its self buckets are already folded and must be masked
+            sskip = ((rnd == 0).astype(jnp.int32) if warm_start else None)
+            st, tiles_f = fold_one(q, f, heap, sskip)
 
             def fold_b(_):
                 st2, t2 = fold_one(q, b, st)
@@ -339,6 +356,17 @@ def ring_total_rounds(num_shards: int) -> int:
     return num_shards // 2 + 1
 
 
+def _warm_tiles(engine: str, npad_local: int, bucket_size: int,
+                num_shards: int) -> int:
+    """[S, S] tiles the warm start scores (one per bucket, every device) —
+    counted into executed-work stats alongside the kernel's measured tile
+    counts, since warm_start_self does that distance work in XLA before
+    the traversal ever runs (self-join drivers only)."""
+    if engine not in ("tiled", "auto", "pallas_tiled"):
+        return 0
+    return num_shards * choose_buckets(npad_local, bucket_size)[0]
+
+
 def _ring_stats(engine: str, tiles_total: int, bucket_size: int,
                 n_q_device_rounds: int, *, q_rows: int | None = None,
                 p_rows: int | None = None) -> dict:
@@ -353,14 +381,14 @@ def _ring_stats(engine: str, tiles_total: int, bucket_size: int,
     ``n_q_device_rounds`` = sum over device-rounds of
     n_queries_local * n_points_local.
 
-    Granularity caveat: the visit-batched Pallas kernel
-    (ops/pallas/knn_tiled.py) DMAs and scores V buckets per while step, so
-    its tile count — and the pair_evals/MFU derived here — is at CHUNK
-    granularity: up to V-1 buckets beyond the prune radius in a started
-    chunk are included. That is the honest count of work *executed* (those
-    lanes really are scored), but it is not comparable with the per-visit
-    kernel's or the XLA twin's per-bucket counts as a measure of pruning
-    quality; compare engines on wall-clock, not pair_evals."""
+    Granularity note: the two tiled engines count DIFFERENT things and
+    their pair_evals are not comparable as pruning quality. The XLA twin
+    counts chunk*V tiles for every chunk with >=1 active bucket (executed
+    VPU work — its dense tile really covers masked buckets,
+    ops/tiled.py body). The Pallas kernel counts only KEPT buckets (its
+    nvis masks chunk-tail and skip_self buckets before the fold), so its
+    broadcast FLOPs over masked lanes go uncounted — pair_evals-derived
+    MFU is a lower bound there. Compare engines on wall-clock."""
     use_tiled = engine in ("tiled", "auto", "pallas_tiled")
     if use_tiled:
         _, s_q = choose_buckets(q_rows or 1, bucket_size)
@@ -402,7 +430,7 @@ def ring_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
     num_shards = mesh.shape[AXIS]
     init_fn, round_fn, final_fn, _sif, _qif, init_from_q, _qfq = \
         _make_ring_fns(k, max_radius, engine, query_tile, point_tile,
-                       bucket_size, num_shards)
+                       bucket_size, num_shards, warm_start=True)
 
     total_rounds = ring_total_rounds(num_shards)
     npad_local = points_sharded.shape[0] // num_shards
@@ -463,7 +491,9 @@ def ring_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
         out += (CandidateState(hd2, hidx),)
     if return_stats:
         out += (_ring_stats(
-            engine, int(np.asarray(tiles).sum()), bucket_size,
+            engine, int(np.asarray(tiles).sum())
+            + _warm_tiles(engine, npad_local, bucket_size, num_shards),
+            bucket_size,
             num_shards * num_shards * npad_local * npad_local,
             q_rows=npad_local, p_rows=npad_local),)
     return out if len(out) > 1 else out[0]
@@ -500,9 +530,6 @@ def ring_knn_stepwise(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
 
     engine = resolve_engine(engine)
     num_shards = mesh.shape[AXIS]
-    init_fn, round_fn, final_fn, _sif, _qif, init_from_q, _qfq = \
-        _make_ring_fns(k, max_radius, engine, query_tile, point_tile,
-                       bucket_size, num_shards)
     spec = P(AXIS)
     check_vma = not engine.startswith("pallas")
     npad_local = points_sharded.shape[0] // num_shards
@@ -517,12 +544,21 @@ def ring_knn_stepwise(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
     ids = jax.device_put(ids_sharded, sharding)
 
     fp = None
+    resuming = False
     if checkpoint_dir:
         fp = ckpt.fingerprint(
             n=int(pts.shape[0]), k=int(k), shards=num_shards, engine=engine,
             max_radius=float(max_radius), bucket_size=bucket_size,
             query_tile=query_tile, point_tile=point_tile, ring="bidir",
             data=ckpt.data_digest(points_sharded, ids_sharded))
+        # decide resume BEFORE init: a resumed run's heap comes from the
+        # checkpoint, and the warm start's [S,S]-per-bucket work would be
+        # computed only to be thrown away
+        resuming = ckpt.peek_round(checkpoint_dir, fp) is not None
+
+    init_fn, round_fn, final_fn, _sif, _qif, init_from_q, _qfq = \
+        _make_ring_fns(k, max_radius, engine, query_tile, point_tile,
+                       bucket_size, num_shards, warm_start=not resuming)
 
     if init_from_q is not None:
         q_parts = partition_sharded(pts, ids, mesh, bucket_size)
@@ -572,6 +608,11 @@ def ring_knn_stepwise(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
         out += (CandidateState(hd2, hidx),)
     if return_stats:
         tiles_total = int(np.sum([np.asarray(t).sum() for t in tiles_parts]))
+        if start == 0:
+            # the warm start ran in THIS session (a resumed run's heap
+            # already carries it — its tiles belong to the first session)
+            tiles_total += _warm_tiles(engine, npad_local, bucket_size,
+                                       num_shards)
         # analytic fold count for flat engines, exact for resumed
         # sessions too (round 0 and the even-R antipodal round fold once)
         folds = _folds_in_rounds(start, stop, num_shards)

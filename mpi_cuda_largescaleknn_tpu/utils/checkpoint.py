@@ -68,6 +68,28 @@ def save_ring_state(ckpt_dir: str, round_idx: int, arrays: dict,
     os.replace(tmp, os.path.join(ckpt_dir, _STATE))
 
 
+def _check_fp(z, manifest: dict, ckpt_dir: str) -> None:
+    saved_fp = json.loads(z["__fingerprint__"].tobytes().decode())
+    want_fp = json.loads(json.dumps(manifest, sort_keys=True))
+    if saved_fp != want_fp:
+        raise ValueError(
+            f"checkpoint at {ckpt_dir} was written for config "
+            f"{saved_fp}, not {want_fp}; remove it (or pass a different "
+            f"--checkpoint-dir) to start fresh")
+
+
+def peek_round(ckpt_dir: str, manifest: dict):
+    """Round index of a valid checkpoint, or None — WITHOUT loading the
+    arrays (np.load reads entries lazily), so drivers can decide whether a
+    run resumes before paying any init work the resume would discard."""
+    spath = os.path.join(ckpt_dir, _STATE)
+    if not os.path.exists(spath):
+        return None
+    with np.load(spath) as z:
+        _check_fp(z, manifest, ckpt_dir)
+        return int(z["__round__"])
+
+
 def load_ring_state(ckpt_dir: str, manifest: dict):
     """Returns (round_idx, arrays dict) or None if absent.
 
@@ -78,13 +100,7 @@ def load_ring_state(ckpt_dir: str, manifest: dict):
     if not os.path.exists(spath):
         return None
     with np.load(spath) as z:
-        saved_fp = json.loads(z["__fingerprint__"].tobytes().decode())
-        want_fp = json.loads(json.dumps(manifest, sort_keys=True))
-        if saved_fp != want_fp:
-            raise ValueError(
-                f"checkpoint at {ckpt_dir} was written for config "
-                f"{saved_fp}, not {want_fp}; remove it (or pass a different "
-                f"--checkpoint-dir) to start fresh")
+        _check_fp(z, manifest, ckpt_dir)
         rnd = int(z["__round__"])
         return rnd, {k: z[k] for k in z.files
                      if k not in ("__round__", "__fingerprint__")}

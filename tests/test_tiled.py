@@ -171,3 +171,64 @@ class TestTiledInRing:
         one = UnorderedKNN(cfg, mesh=get_mesh(1)).run(pts)
         eight = UnorderedKNN(cfg, mesh=get_mesh(8)).run(pts)
         np.testing.assert_array_equal(one, eight)
+
+
+class TestWarmStart:
+    """warm_start_self + skip_self (the cold-heap fold-pass eliminator the
+    ring/demand self-join drivers use) against the cold traversal."""
+
+    def test_warm_plus_skip_bitidentical_to_cold(self):
+        from mpi_cuda_largescaleknn_tpu.ops.tiled import warm_start_self
+
+        pts = random_points(700, seed=41)
+        k = 7
+        q = partition_points(jnp.asarray(pts), bucket_size=32)
+        cold = knn_update_tiled(
+            init_candidates(q.num_buckets * q.bucket_size, k), q, q)
+        warm0 = warm_start_self(q, k)
+        warm = knn_update_tiled(warm0, q, q, skip_self=jnp.int32(1))
+        # real rows only: pad rows may differ (warm start folds pad-vs-pad
+        # zero distances the cold path masks; drivers trim pad rows anyway)
+        real = np.asarray(q.ids).reshape(-1) >= 0
+        np.testing.assert_array_equal(np.asarray(warm.dist2)[real],
+                                      np.asarray(cold.dist2)[real])
+        np.testing.assert_array_equal(np.asarray(warm.idx)[real],
+                                      np.asarray(cold.idx)[real])
+
+    def test_warm_plus_skip_bitidentical_pallas(self):
+        from mpi_cuda_largescaleknn_tpu.ops.pallas.knn_tiled import (
+            knn_update_tiled_pallas,
+        )
+        from mpi_cuda_largescaleknn_tpu.ops.tiled import warm_start_self
+
+        pts = random_points(600, seed=42)
+        k = 5
+        q = partition_points(jnp.asarray(pts), bucket_size=32)
+        cold = knn_update_tiled_pallas(
+            init_candidates(q.num_buckets * q.bucket_size, k), q, q)
+        warm0 = warm_start_self(q, k)
+        warm, visits = knn_update_tiled_pallas(
+            warm0, q, q, skip_self=jnp.int32(1), with_stats=True)
+        cold2, visits_cold = knn_update_tiled_pallas(
+            init_candidates(q.num_buckets * q.bucket_size, k), q, q,
+            with_stats=True)
+        real = np.asarray(q.ids).reshape(-1) >= 0
+        np.testing.assert_array_equal(np.asarray(warm.dist2)[real],
+                                      np.asarray(cold.dist2)[real])
+        np.testing.assert_array_equal(np.asarray(warm.idx)[real],
+                                      np.asarray(cold.idx)[real])
+        # the skipped self buckets must show up as fewer counted visits
+        assert int(visits) < int(visits_cold)
+
+    def test_warm_start_respects_max_radius(self):
+        from mpi_cuda_largescaleknn_tpu.ops.tiled import warm_start_self
+
+        pts = random_points(400, seed=43)
+        k, r = 25, 0.15
+        q = partition_points(jnp.asarray(pts), bucket_size=32)
+        warm0 = warm_start_self(q, k, max_radius=r)
+        st = knn_update_tiled(warm0, q, q, skip_self=jnp.int32(1))
+        d = np.asarray(scatter_back(
+            extract_final_result(st).reshape(q.num_buckets, q.bucket_size),
+            q.pos, len(pts), fill=jnp.inf))
+        assert_dist_equal(d, kth_nn_dist(pts, pts, k, max_radius=r))
