@@ -24,6 +24,9 @@ TPU-side options (no reference analogue):
   --query-tile N    queries per inner tile (flat engines; default 2048)
   --point-tile N    tree points per inner tile (flat engines; default 2048)
   --bucket-size N   points per spatial bucket (tiled engine; default 512)
+  --point-group N   coarsen the resident point side by this power-of-two
+                    factor (tiled self-join drivers; default 1; not
+                    combinable with --query-chunk)
   --query-chunk N   stream queries in chunks of N rows per device;
                     bounds candidate-heap memory to N*k per device for runs
                     whose heaps exceed HBM (e.g. -k 100 at 100M+ points)
@@ -64,7 +67,8 @@ def parse_args(program: str, argv: list[str]):
     in_path = ""
     out_path = ""
     extras = {"shards": None, "engine": "auto", "query_tile": 2048,
-              "point_tile": 2048, "bucket_size": 512, "profile_dir": None,
+              "point_tile": 2048, "bucket_size": 512, "point_group": 1,
+              "profile_dir": None,
               "timings": False, "checkpoint_dir": None, "checkpoint_every": 1,
               "write_indices": None, "query_chunk": 0, "selfcheck": 0,
               "coordinator": None, "num_hosts": 1, "host_id": 0}
@@ -92,6 +96,8 @@ def parse_args(program: str, argv: list[str]):
                 i += 1; extras["point_tile"] = int(argv[i])
             elif arg == "--bucket-size":
                 i += 1; extras["bucket_size"] = int(argv[i])
+            elif arg == "--point-group":
+                i += 1; extras["point_group"] = int(argv[i])
             elif arg == "--profile-dir":
                 i += 1; extras["profile_dir"] = argv[i]
             elif arg == "--timings":
@@ -129,6 +135,7 @@ def parse_args(program: str, argv: list[str]):
                     engine=extras["engine"], query_tile=extras["query_tile"],
                     point_tile=extras["point_tile"],
                     bucket_size=extras["bucket_size"],
+                    point_group=extras["point_group"],
                     num_shards=extras["shards"] or 0,
                     query_chunk=extras["query_chunk"],
                     profile_dir=extras["profile_dir"],

@@ -29,6 +29,11 @@ class KnnConfig:
     query_tile: int = 2048           # queries processed per inner tile
     point_tile: int = 2048           # tree points per inner tile
     bucket_size: int = 512           # tiled engine: points per spatial bucket
+    point_group: int = 1             # tiled self-join drivers: coarsen the
+                                     # point side by this power-of-two factor
+                                     # (fine query buckets -> tighter prune
+                                     # radius; wide resident tiles -> DMA and
+                                     # fold efficiency; docs/TUNING.md)
     num_shards: int = 1              # size of the 1-D mesh axis
     query_chunk: int = 0             # >0: stream queries in chunks of this
                                      # many rows/device (bounds heap memory
@@ -45,3 +50,14 @@ class KnnConfig:
         if self.engine not in ("auto", "tiled", "pallas_tiled", "bruteforce",
                                "tree", "pallas"):
             raise ValueError(f"unknown engine '{self.engine}'")
+        pg = self.point_group
+        if pg < 1 or (pg & (pg - 1)) != 0:
+            raise ValueError(
+                f"point_group must be a power of two >= 1, got {pg}")
+        if pg > 1 and self.query_chunk > 0:
+            # chunked queries are partitioned per chunk: there is no
+            # self-join bucket correspondence for the coarsening to use —
+            # fail loudly rather than silently ignore the knob
+            raise ValueError(
+                "point_group > 1 is not supported with query_chunk "
+                "(chunked queries have no self-join bucket correspondence)")

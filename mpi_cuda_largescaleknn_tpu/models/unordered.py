@@ -88,6 +88,7 @@ class UnorderedKNN:
                     flat, ids, cfg.k, self.mesh, max_radius=cfg.max_radius,
                     engine=cfg.engine, query_tile=cfg.query_tile,
                     point_tile=cfg.point_tile, bucket_size=cfg.bucket_size,
+                    point_group=cfg.point_group,
                     checkpoint_dir=cfg.checkpoint_dir,
                     checkpoint_every=cfg.checkpoint_every,
                     return_candidates=return_neighbors, return_stats=True)
@@ -96,6 +97,7 @@ class UnorderedKNN:
                     flat, ids, cfg.k, self.mesh, max_radius=cfg.max_radius,
                     engine=cfg.engine, query_tile=cfg.query_tile,
                     point_tile=cfg.point_tile, bucket_size=cfg.bucket_size,
+                    point_group=cfg.point_group,
                     return_candidates=return_neighbors, return_stats=True)
             if return_neighbors:
                 dists, cands, self.last_stats = got

@@ -55,7 +55,7 @@ def _kernel(order_ref, boxd2_ref,            # SMEM: [1, 1, Bp] i32 / f32
             out_d2_ref, out_idx_ref,         # VMEM: [S, k]
             vis_ref,                         # SMEM: [1, 1, 1] i32 visits
             p_buf, id_buf, sem_p, sem_i,     # scratch: [2,4,V*T], [2,1,V*T],
-            *, visit_batch):                 #          (2,V), (2,V)
+            *, visit_batch, self_group):     #          (2,V), (2,V)
     num_pb = p_hbm.shape[0]
     t_p = p_hbm.shape[2]
     v_b = visit_batch
@@ -102,8 +102,9 @@ def _kernel(order_ref, boxd2_ref,            # SMEM: [1, 1, Bp] i32 / f32
     start_chunk(0, 0)
     lane = lax.broadcasted_iota(jnp.int32, (1, v_b * t_p), 1)
     # read once at kernel scope: program_id inside the while body does not
-    # lower under the CPU interpreter's HLO path
-    b_cur = pl.program_id(0)
+    # lower under the CPU interpreter's HLO path. The own resident bucket
+    # is b // self_group (coarsened point side, ops/partition.py)
+    b_own = pl.program_id(0) // self_group
     sskip = sskip_ref[0, 0, 0] != 0
 
     def cond(carry):
@@ -144,7 +145,7 @@ def _kernel(order_ref, boxd2_ref,            # SMEM: [1, 1, Bp] i32 / f32
         worst_c = worst2(cd2)
         s_idxs = [jnp.minimum(c * v_b + v, num_pb - 1) for v in range(v_b)]
         keep_v = [(boxd2_ref[0, 0, si] < worst_c)
-                  & ~((order_ref[0, 0, si] == b_cur) & sskip)
+                  & ~((order_ref[0, 0, si] == b_own) & sskip)
                   for si in s_idxs]           # static unroll, SMEM scalars
         # the last chunk may be padded with duplicates of bucket num_pb-1:
         # folding a point twice would corrupt the candidate list, so those
@@ -198,15 +199,17 @@ def _vmem_limit(s_q: int, t_p: int, visit_batch: int, k: int) -> int:
     return min(max(2 * need, default), 100 * 1024 * 1024)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "visit_batch"))
+@functools.partial(jax.jit, static_argnames=("interpret", "visit_batch",
+                                             "self_group"))
 def _run(order, boxd2, sskip, q_pts, q_ids, in_d2, in_idx, p_t, pid_t, *,
-         interpret, visit_batch):
+         interpret, visit_batch, self_group):
     num_qb, s_q, _one = q_ids.shape
     num_pb, _, t_p = p_t.shape
     k = in_d2.shape[-1]
     grid = (num_qb,)
     out_d2, out_idx, visits = pl.pallas_call(
-        functools.partial(_kernel, visit_batch=visit_batch),
+        functools.partial(_kernel, visit_batch=visit_batch,
+                          self_group=self_group),
         grid=grid,
         in_specs=[
             # Mosaic requires the LAST TWO block dims to be sublane/lane
@@ -274,7 +277,7 @@ def knn_update_tiled_pallas(state: CandidateState, q: BucketedPoints,
                             interpret: bool | None = None,
                             with_stats: bool = False,
                             visit_batch: int | None = None,
-                            skip_self=None):
+                            skip_self=None, self_group: int = 1):
     """Drop-in Pallas twin of ``ops.tiled.knn_update_tiled`` (same contract:
     state rows in ``q``'s bucket order; folds every real point of ``p`` in;
     ``with_stats`` additionally returns the i32 count of [S, T] tiles
@@ -324,7 +327,8 @@ def knn_update_tiled_pallas(state: CandidateState, q: BucketedPoints,
                                    ss, q.pts, q.ids[:, :, None],
                                    state.dist2, state.idx, p_t, pid_t,
                                    interpret=interpret,
-                                   visit_batch=visit_batch)
+                                   visit_batch=visit_batch,
+                                   self_group=self_group)
     out = CandidateState(out_d2, out_idx)
     if with_stats:
         return out, jnp.sum(visits).astype(jnp.int32)

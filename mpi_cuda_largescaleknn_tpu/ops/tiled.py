@@ -85,6 +85,12 @@ def warm_start_self(q: BucketedPoints, k: int,
     Semantics match the fold exactly: strict-< adoption against the
     ``max_radius`` cutoff slots (merge_candidates' stable existing-first
     sort), pad lanes carry +inf distance, self counts as neighbor 0.
+
+    Candidate rows are independent, so a coarsened self-join (the
+    ``point_group`` knob) simply passes ``coarsen_buckets(q, group)`` here:
+    the returned rows are in the same flat order (the coarsening is a
+    reshape) and each query pre-folds its containing coarse bucket — the
+    traversal's skip mask must then use the same ``group``.
     """
     num_qb, s = q.ids.shape
     init = init_candidates(num_qb * s, k, max_radius)
@@ -105,9 +111,10 @@ def warm_start_self(q: BucketedPoints, k: int,
         return st.dist2, st.idx
 
     # sequential over buckets would serialize thousands of small ops (the
-    # round-3 lesson); batch_size vmaps blocks of buckets per map step
-    hd2, hidx = lax.map(one, (q.pts, q.ids, hd2, hidx),
-                        batch_size=min(64, num_qb))
+    # round-3 lesson); batch_size vmaps blocks of buckets per map step,
+    # sized so the [batch, S, S] tile stays ~128MB whatever S is
+    batch = max(1, min(64, num_qb, (1 << 25) // max(s * s, 1)))
+    hd2, hidx = lax.map(one, (q.pts, q.ids, hd2, hidx), batch_size=batch)
     return CandidateState(hd2.reshape(num_qb * s, k),
                           hidx.reshape(num_qb * s, k))
 
@@ -115,7 +122,7 @@ def warm_start_self(q: BucketedPoints, k: int,
 def knn_update_tiled(state: CandidateState, q: BucketedPoints,
                      p: BucketedPoints, *, chunk_buckets: int | None = None,
                      visits_per_step: int = 8, with_stats: bool = False,
-                     skip_self=None):
+                     skip_self=None, self_group: int = 1):
     """Fold every real point of ``p`` into the candidate state (one
     reference ``runQuery`` launch, at bucket granularity).
 
@@ -134,10 +141,11 @@ def knn_update_tiled(state: CandidateState, q: BucketedPoints,
     ops, not arithmetic; V-batching plus the wider chunk budget cuts the
     sequential-op count by ~V * (new_budget / old_budget).
 
-    ``skip_self``: traced i32/bool scalar; when nonzero, point bucket ``b``
-    is never folded into query bucket ``b`` — for self-joins whose heap was
-    pre-filled by ``warm_start_self`` (``q`` and ``p`` must then be the
-    SAME partition, so bucket indices correspond).
+    ``skip_self``: traced i32/bool scalar; when nonzero, point bucket
+    ``b // self_group`` is never folded into query bucket ``b`` — for
+    self-joins whose heap was pre-filled by ``warm_start_self`` (``p``
+    must then be ``coarsen_buckets`` of ``q``'s partition with the same
+    ``self_group``, so bucket indices correspond).
     """
     num_qb, s_q = q.ids.shape
     num_pb, s_p = p.ids.shape
@@ -179,8 +187,9 @@ def knn_update_tiled(state: CandidateState, q: BucketedPoints,
         visit_d2 = lax.dynamic_slice_in_dim(sorted_d2, step * v, v, axis=1)
         active = visit_d2 < worst2[:, None]                      # [Bq, V]
         if skip_self is not None:
-            self_hit = visit == jnp.arange(num_qb, dtype=visit.dtype)[:, None]
-            active &= ~(self_hit & (jnp.asarray(skip_self) != 0))
+            own = (jnp.arange(num_qb, dtype=visit.dtype)
+                   // self_group)[:, None]
+            active &= ~((visit == own) & (jnp.asarray(skip_self) != 0))
         pts_v = p.pts[visit]                                     # [Bq,V,T,3]
         ids_v = p.ids[visit]                                     # [Bq,V,T]
 

@@ -81,11 +81,13 @@ from mpi_cuda_largescaleknn_tpu.ops.candidates import (
 )
 from mpi_cuda_largescaleknn_tpu.ops.partition import (
     BucketedPoints,
+    coarsen_buckets,
     scatter_back,
 )
 from mpi_cuda_largescaleknn_tpu.ops.tiled import warm_start_self
 from mpi_cuda_largescaleknn_tpu.parallel.mesh import AXIS, pvary
 from mpi_cuda_largescaleknn_tpu.parallel.ring import (
+    _effective_group,
     _engine_fn,
     _tiled_engine_fn,
     partition_sharded,
@@ -106,7 +108,8 @@ def gathered_bounds_fn(pts_local):
 
 
 def _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
-                     bucket_size, num_shards, warm_start=False):
+                     bucket_size, num_shards, warm_start=False,
+                     point_group=1):
     """Per-round builders shared by the fused, stepwise, and chunked demand
     drivers. Returns (init_fn, round_fn, final_fn, shard_init_fn,
     query_init_fn, init_from_q, query_init_from_q);
@@ -182,13 +185,18 @@ def _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
         return ctx, heap
 
     def init_from_q(pts_local, q):
-        shard_state = (q.pts, q.ids, q.lower, q.upper)
+        # point side: group-coarsened view of the same partition (tight
+        # fine-bucket prune radius, point_group x wider resident tiles)
+        pc = coarsen_buckets(q, point_group)
+        shard_state = (pc.pts, pc.ids, pc.lower, pc.upper)
         all_lower, all_upper = gathered_bounds_fn(pts_local)
         ctx, heap = query_init_from_q(pts_local, q, all_lower, all_upper)
         if warm_start:
-            # exact top-k of every query's own bucket (ops/tiled.py);
-            # round 0's own-shard visit then masks the self bucket
-            heap = warm_start_self(q, k, max_radius)
+            # exact top-k of every query's own (containing) resident
+            # bucket (ops/tiled.py, rows stay in fine order — the
+            # coarsening is a reshape); round 0's own-shard visit then
+            # masks that bucket
+            heap = warm_start_self(pc, k, max_radius)
         return ctx, (shard_state, shard_state), heap
 
     def init_fn(pts_local, ids_local):
@@ -251,7 +259,7 @@ def _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
                     shard_state[0], shard_state[1], shard_state[2],
                     shard_state[3], shard_state[1])
                 st = tiled_update(heap, stationary, resident,
-                                  skip_self=sskip)
+                                  skip_self=sskip, self_group=point_group)
             else:
                 st = update(heap, stationary, *shard_state)
             return st.dist2, st.idx
@@ -322,7 +330,7 @@ def demand_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
                mesh, *, max_radius: float = jnp.inf,
                engine: str = "auto", query_tile: int = 2048,
                point_tile: int = 2048, bucket_size: int = 512,
-               return_stats: bool = False):
+               point_group: int = 1, return_stats: bool = False):
     """Bounds-pruned kNN over pre-partitioned shards on a 1-D mesh (fused
     on-device ``lax.while_loop``).
 
@@ -334,9 +342,11 @@ def demand_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
     engine = resolve_engine(engine)
     num_shards = mesh.shape[AXIS]
     npad = points_sharded.shape[0] // num_shards
+    point_group = _effective_group(point_group, npad, bucket_size)
     init_fn, round_fn, final_fn, _sif, _qif, init_from_q, _qfq = \
         _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
-                         bucket_size, num_shards, warm_start=True)
+                         bucket_size, num_shards, warm_start=True,
+                         point_group=point_group)
 
     def body(pts_local, ids_local, q_local=None):
         if q_local is not None:
@@ -403,7 +413,7 @@ def demand_knn_stepwise(points_sharded: jnp.ndarray,
                         ids_sharded: jnp.ndarray, k: int, mesh, *,
                         max_radius: float = jnp.inf, engine: str = "auto",
                         query_tile: int = 2048, point_tile: int = 2048,
-                        bucket_size: int = 512,
+                        bucket_size: int = 512, point_group: int = 1,
                         checkpoint_dir: str | None = None,
                         checkpoint_every: int = 1,
                         max_rounds: int | None = None,
@@ -421,6 +431,7 @@ def demand_knn_stepwise(points_sharded: jnp.ndarray,
     engine = resolve_engine(engine)
     num_shards = mesh.shape[AXIS]
     npad = points_sharded.shape[0] // num_shards
+    point_group = _effective_group(point_group, npad, bucket_size)
     spec = P(AXIS)
     check_vma = not engine.startswith("pallas")
     sharding = NamedSharding(mesh, spec)
@@ -439,6 +450,9 @@ def demand_knn_stepwise(points_sharded: jnp.ndarray,
         fp = ckpt.fingerprint(
             n=int(pts.shape[0]), k=int(k), shards=num_shards, engine=engine,
             max_radius=float(max_radius), bucket_size=bucket_size,
+            # key present only when active: default-group runs keep
+            # resumability of checkpoints written before the knob existed
+            **({"point_group": point_group} if point_group > 1 else {}),
             query_tile=query_tile, point_tile=point_tile,
             # -rg: counts carry [kernels, rotations] — older single-counter
             # checkpoints must not resume into the new shape
@@ -451,7 +465,8 @@ def demand_knn_stepwise(points_sharded: jnp.ndarray,
 
     init_fn, round_fn, final_fn, _sif, _qif, init_from_q, _qfq = \
         _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
-                         bucket_size, num_shards, warm_start=not resuming)
+                         bucket_size, num_shards, warm_start=not resuming,
+                         point_group=point_group)
 
     if init_from_q is not None:
         q_parts = partition_sharded(pts, ids, mesh, bucket_size)

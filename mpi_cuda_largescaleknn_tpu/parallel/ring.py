@@ -57,6 +57,7 @@ from mpi_cuda_largescaleknn_tpu.ops.partition import (
     BucketedPoints,
     _partition_level,
     choose_buckets,
+    coarsen_buckets,
     partition_finalize,
     partition_prep,
     scatter_back,
@@ -174,7 +175,7 @@ def _tiled_engine_fn(engine: str):
 
 
 def _make_ring_fns(k, max_radius, engine, query_tile, point_tile, bucket_size,
-                   num_shards, warm_start=False):
+                   num_shards, warm_start=False, point_group=1):
     """(init_fn, round_fn, final_fn, shard_init_fn, query_init_fn) — the
     per-round pieces every ring driver executes, defined once so the fused,
     stepwise and chunked paths cannot diverge.
@@ -234,14 +235,20 @@ def _make_ring_fns(k, max_radius, engine, query_tile, point_tile, bucket_size,
             return q, heap
 
         def init_from_q(q):
+            # the rotating point side is a GROUP-coarsened view of the
+            # same partition (ops/partition.py coarsen_buckets): fine
+            # query buckets keep the prune radius tight while the resident
+            # tiles stay point_group x wider for DMA/fold efficiency
+            pc = coarsen_buckets(q, point_group)
             if warm_start:
-                # exact top-k of every query's own bucket, folded before
-                # the traversal (ops/tiled.py warm_start_self) — round 0's
-                # kernel then masks the self bucket (skip_self below)
-                heap = warm_start_self(q, k, max_radius)
+                # exact top-k of every query's own (containing) resident
+                # bucket, folded before the traversal — round 0's kernel
+                # then masks that bucket (skip_self below). Rows come back
+                # in fine order: the coarsening is a reshape
+                heap = warm_start_self(pc, k, max_radius)
             else:
-                q, heap = query_from_q(q)
-            shard = (q.pts, q.ids, q.lower, q.upper)
+                _, heap = query_from_q(q)
+            shard = (pc.pts, pc.ids, pc.lower, pc.upper)
             return q, (shard, shard), heap
 
         def fold_one(q, shard, heap, sskip=None):
@@ -251,7 +258,7 @@ def _make_ring_fns(k, max_radius, engine, query_tile, point_tile, bucket_size,
             resident = BucketedPoints(shard[0], shard[1], shard[2], shard[3],
                                       shard[1])
             return tiled_update(heap, q, resident, with_stats=True,
-                                skip_self=sskip)
+                                skip_self=sskip, self_group=point_group)
 
         def round_fn(q, shard_pair, heap, rnd, rotate=True):
             # the final round's rotation would be discarded — callers pass
@@ -356,6 +363,16 @@ def ring_total_rounds(num_shards: int) -> int:
     return num_shards // 2 + 1
 
 
+def _effective_group(point_group: int, npad_local: int,
+                     bucket_size: int) -> int:
+    """Clamp the point-side coarsening factor to the actual bucket count
+    (both are powers of two, so the clamped value always divides)."""
+    if point_group <= 1:
+        return 1
+    assert point_group & (point_group - 1) == 0, point_group
+    return min(point_group, choose_buckets(npad_local, bucket_size)[0])
+
+
 def _warm_tiles(engine: str, npad_local: int, bucket_size: int,
                 num_shards: int) -> int:
     """[S, S] tiles the warm start scores (one per bucket, every device) —
@@ -369,7 +386,7 @@ def _warm_tiles(engine: str, npad_local: int, bucket_size: int,
 
 def _ring_stats(engine: str, tiles_total: int, bucket_size: int,
                 n_q_device_rounds: int, *, q_rows: int | None = None,
-                p_rows: int | None = None) -> dict:
+                p_rows: int | None = None, point_group: int = 1) -> dict:
     """Executed-work stats: distance pairs actually scored.
 
     Tiled engines report measured tile counts (pruning makes the count
@@ -393,7 +410,9 @@ def _ring_stats(engine: str, tiles_total: int, bucket_size: int,
     if use_tiled:
         _, s_q = choose_buckets(q_rows or 1, bucket_size)
         _, s_p = choose_buckets(p_rows or q_rows or 1, bucket_size)
-        pair_evals = int(tiles_total) * s_q * s_p
+        # coarsened point side: one visited tile spans point_group fine
+        # buckets' lanes (ops/partition.py coarsen_buckets)
+        pair_evals = int(tiles_total) * s_q * s_p * point_group
     elif engine == "tree":
         # the stack-free traversal is bounds-pruned and uninstrumented:
         # all-pairs would overstate executed work by orders of magnitude
@@ -408,7 +427,8 @@ def _ring_stats(engine: str, tiles_total: int, bucket_size: int,
 def ring_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
              mesh, *, max_radius: float = jnp.inf, engine: str = "auto",
              query_tile: int = 2048, point_tile: int = 2048,
-             bucket_size: int = 512, return_candidates: bool = False,
+             bucket_size: int = 512, point_group: int = 1,
+             return_candidates: bool = False,
              return_stats: bool = False):
     """Run the full R-round ring on a 1-D mesh (fused ``lax.fori_loop``).
 
@@ -428,12 +448,13 @@ def ring_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
     """
     engine = resolve_engine(engine)
     num_shards = mesh.shape[AXIS]
-    init_fn, round_fn, final_fn, _sif, _qif, init_from_q, _qfq = \
-        _make_ring_fns(k, max_radius, engine, query_tile, point_tile,
-                       bucket_size, num_shards, warm_start=True)
-
     total_rounds = ring_total_rounds(num_shards)
     npad_local = points_sharded.shape[0] // num_shards
+    point_group = _effective_group(point_group, npad_local, bucket_size)
+    init_fn, round_fn, final_fn, _sif, _qif, init_from_q, _qfq = \
+        _make_ring_fns(k, max_radius, engine, query_tile, point_tile,
+                       bucket_size, num_shards, warm_start=True,
+                       point_group=point_group)
 
     def body(pts_local, ids_local, q_local=None):
         if q_local is not None:
@@ -495,7 +516,8 @@ def ring_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
             + _warm_tiles(engine, npad_local, bucket_size, num_shards),
             bucket_size,
             num_shards * num_shards * npad_local * npad_local,
-            q_rows=npad_local, p_rows=npad_local),)
+            q_rows=npad_local, p_rows=npad_local,
+            point_group=point_group),)
     return out if len(out) > 1 else out[0]
 
 
@@ -503,6 +525,7 @@ def ring_knn_stepwise(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
                       k: int, mesh, *, max_radius: float = jnp.inf,
                       engine: str = "auto", query_tile: int = 2048,
                       point_tile: int = 2048, bucket_size: int = 512,
+                      point_group: int = 1,
                       checkpoint_dir: str | None = None,
                       checkpoint_every: int = 1,
                       max_rounds: int | None = None,
@@ -533,6 +556,7 @@ def ring_knn_stepwise(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
     spec = P(AXIS)
     check_vma = not engine.startswith("pallas")
     npad_local = points_sharded.shape[0] // num_shards
+    point_group = _effective_group(point_group, npad_local, bucket_size)
 
     def smap(fn, n_in, out_structs):
         return jax.jit(jax.shard_map(
@@ -549,6 +573,9 @@ def ring_knn_stepwise(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
         fp = ckpt.fingerprint(
             n=int(pts.shape[0]), k=int(k), shards=num_shards, engine=engine,
             max_radius=float(max_radius), bucket_size=bucket_size,
+            # key present only when active: default-group runs keep
+            # resumability of checkpoints written before the knob existed
+            **({"point_group": point_group} if point_group > 1 else {}),
             query_tile=query_tile, point_tile=point_tile, ring="bidir",
             data=ckpt.data_digest(points_sharded, ids_sharded))
         # decide resume BEFORE init: a resumed run's heap comes from the
@@ -558,7 +585,8 @@ def ring_knn_stepwise(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
 
     init_fn, round_fn, final_fn, _sif, _qif, init_from_q, _qfq = \
         _make_ring_fns(k, max_radius, engine, query_tile, point_tile,
-                       bucket_size, num_shards, warm_start=not resuming)
+                       bucket_size, num_shards, warm_start=not resuming,
+                       point_group=point_group)
 
     if init_from_q is not None:
         q_parts = partition_sharded(pts, ids, mesh, bucket_size)
@@ -619,7 +647,8 @@ def ring_knn_stepwise(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
         out += (_ring_stats(
             engine, tiles_total, bucket_size,
             folds * num_shards * npad_local * npad_local,
-            q_rows=npad_local, p_rows=npad_local),)
+            q_rows=npad_local, p_rows=npad_local,
+            point_group=point_group),)
     return out if len(out) > 1 else out[0]
 
 

@@ -232,3 +232,86 @@ class TestWarmStart:
             extract_final_result(st).reshape(q.num_buckets, q.bucket_size),
             q.pos, len(pts), fill=jnp.inf))
         assert_dist_equal(d, kth_nn_dist(pts, pts, k, max_radius=r))
+
+
+class TestPointGroup:
+    """Coarsened point side (point_group knob): fine query buckets keep the
+    prune radius tight while resident tiles stay wide."""
+
+    def test_coarsen_buckets_is_reshape(self):
+        from mpi_cuda_largescaleknn_tpu.ops.partition import coarsen_buckets
+
+        pts = random_points(500, seed=51)
+        q = partition_points(jnp.asarray(pts), bucket_size=16)
+        c = coarsen_buckets(q, 4)
+        assert c.num_buckets == q.num_buckets // 4
+        assert c.bucket_size == q.bucket_size * 4
+        np.testing.assert_array_equal(
+            np.asarray(c.pts).reshape(-1, 3), np.asarray(q.pts).reshape(-1, 3))
+        np.testing.assert_array_equal(
+            np.asarray(c.ids).reshape(-1), np.asarray(q.ids).reshape(-1))
+        # union bounds cover every real point of the group
+        p = np.asarray(c.pts)
+        lo, hi = np.asarray(c.lower), np.asarray(c.upper)
+        for b in range(c.num_buckets):
+            real = p[b][p[b, :, 0] < PAD_SENTINEL / 2]
+            if len(real):
+                assert np.all(real >= lo[b] - 1e-6)
+                assert np.all(real <= hi[b] + 1e-6)
+
+    def test_unordered_group_matches_group1(self):
+        from mpi_cuda_largescaleknn_tpu.core.config import KnnConfig
+        from mpi_cuda_largescaleknn_tpu.models.unordered import UnorderedKNN
+        from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+
+        pts = random_points(900, seed=52)
+        base = UnorderedKNN(KnnConfig(k=6, engine="tiled", bucket_size=16),
+                            mesh=get_mesh(1)).run(pts)
+        grouped = UnorderedKNN(
+            KnnConfig(k=6, engine="tiled", bucket_size=16, point_group=4),
+            mesh=get_mesh(1)).run(pts)
+        np.testing.assert_array_equal(base, grouped)
+
+    def test_unordered_group_pallas_oracle_8dev(self):
+        from mpi_cuda_largescaleknn_tpu.core.config import KnnConfig
+        from mpi_cuda_largescaleknn_tpu.models.unordered import UnorderedKNN
+        from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+
+        pts = random_points(800, seed=53)
+        model = UnorderedKNN(
+            KnnConfig(k=4, engine="pallas_tiled", bucket_size=16,
+                      point_group=2), mesh=get_mesh(8))
+        got = model.run(pts)
+        assert_dist_equal(got, kth_nn_dist(pts, pts, 4))
+        assert model.last_stats["pair_evals"] > 0
+
+    def test_demand_group_matches_group1(self):
+        from mpi_cuda_largescaleknn_tpu.core.config import KnnConfig
+        from mpi_cuda_largescaleknn_tpu.models.prepartitioned import (
+            PrePartitionedKNN,
+        )
+        from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+
+        pts = random_points(800, seed=54)
+        pts = pts[np.argsort(pts[:, 0], kind="stable")]
+        parts = [pts[i * 200:(i + 1) * 200] for i in range(4)]
+        base = PrePartitionedKNN(
+            KnnConfig(k=5, engine="tiled", bucket_size=16),
+            mesh=get_mesh(4)).run(parts)
+        grouped = PrePartitionedKNN(
+            KnnConfig(k=5, engine="tiled", bucket_size=16, point_group=4),
+            mesh=get_mesh(4)).run(parts)
+        for b, g in zip(base, grouped):
+            np.testing.assert_array_equal(b, g)
+
+    def test_group_clamps_to_bucket_count(self):
+        from mpi_cuda_largescaleknn_tpu.core.config import KnnConfig
+        from mpi_cuda_largescaleknn_tpu.models.unordered import UnorderedKNN
+        from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+
+        # tiny input: group far exceeds the bucket count -> clamped, exact
+        pts = random_points(60, seed=55)
+        got = UnorderedKNN(
+            KnnConfig(k=3, engine="tiled", bucket_size=16, point_group=64),
+            mesh=get_mesh(1)).run(pts)
+        assert_dist_equal(got, kth_nn_dist(pts, pts, 3))

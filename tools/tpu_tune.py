@@ -42,6 +42,7 @@ n, k = spec["n"], spec["k"]
 pts = np.random.default_rng(7).random((n, 3)).astype(np.float32)
 cfg = KnnConfig(k=k, engine=spec["engine"],
                 bucket_size=spec.get("bucket_size", 512),
+                point_group=spec.get("point_group", 1),
                 query_tile=spec.get("query_tile", 2048),
                 point_tile=spec.get("point_tile", 2048))
 model = UnorderedKNN(cfg, mesh=get_mesh(1))
@@ -86,6 +87,12 @@ def _cells(quick: bool):
         for lanes in LANES:
             cells.append({"engine": "pallas_tiled", "n": n8, "k": 8,
                           "bucket_size": b, "env": {"LSK_CHUNK_LANES": lanes}})
+    # decoupled prune/tile geometry: fine query buckets, coarse point side
+    # (escapes the bucket-size diagonal — docs/TUNING.md point_group row)
+    for b, g in ((128, 4), (128, 8), (256, 2), (256, 4)):
+        cells.append({"engine": "pallas_tiled", "n": n8, "k": 8,
+                      "bucket_size": b, "point_group": g,
+                      "env": {"LSK_CHUNK_LANES": "2048"}})
     # engine sanity rows at the sweep size
     cells.append({"engine": "tiled", "n": n8, "k": 8, "bucket_size": 512})
     cells.append({"engine": "pallas", "n": min(n8, 200_000), "k": 8,
@@ -145,7 +152,8 @@ def main() -> int:
                      and "qps" in r]
             for r in sorted(swept, key=lambda r: -r["qps"])[:2]:
                 spec = {kk: r[kk] for kk in
-                        ("engine", "k", "bucket_size", "env") if kk in r}
+                        ("engine", "k", "bucket_size", "point_group", "env")
+                        if kk in r}
                 _run_cell({**spec, "n": confirm_n, "confirm": True}, results)
     return 0
 
