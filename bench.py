@@ -21,7 +21,9 @@ CPU-fallback measurement run. Probe outcome/duration is recorded in the
 output JSON either way.
 
 Env knobs: BENCH_N (ladder start), BENCH_K, BENCH_ENGINE, BENCH_REPS,
-BENCH_BUDGET_S (total wall budget, default 900).
+BENCH_BUDGET_S (total wall budget, default 900), BENCH_BUCKET_SIZE /
+BENCH_POINT_GROUP (tile geometry, defaults from KnnConfig — tpu_tune.py
+measures which geometry wins on chip).
 """
 
 from __future__ import annotations
@@ -66,6 +68,14 @@ from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
 mesh = get_mesh(1)
 rng = np.random.default_rng(7)
 reps = max(1, int(os.environ.get("BENCH_REPS", 2)))
+# parse geometry knobs ONCE, before the ladder: a malformed value must
+# fail fast, not burn the whole bench budget as per-rung engine failures
+cfg_kw = {}
+if os.environ.get("BENCH_BUCKET_SIZE"):
+    cfg_kw["bucket_size"] = int(os.environ["BENCH_BUCKET_SIZE"])
+if os.environ.get("BENCH_POINT_GROUP"):
+    cfg_kw["point_group"] = int(os.environ["BENCH_POINT_GROUP"])
+KnnConfig(k=k, **cfg_kw).validate()
 # auto resolves to the Pallas kernel on TPU; if Mosaic rejects it at this
 # shape, fall back to the XLA twin WITHIN the TPU attempt (a kernel bug
 # must not demote the whole measurement to the CPU ladder)
@@ -80,7 +90,7 @@ for n in ladder:
   for eng_i, eng in enumerate(candidates):
     try:
         pts = rng.random((n, 3)).astype(np.float32)
-        model = UnorderedKNN(KnnConfig(k=k, engine=eng), mesh=mesh)
+        model = UnorderedKNN(KnnConfig(k=k, engine=eng, **cfg_kw), mesh=mesh)
         print("STAGE " + json.dumps({"warmup_start": {"n": n, "engine": eng}}),
               flush=True)
         t0 = time.perf_counter()

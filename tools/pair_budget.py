@@ -1,0 +1,85 @@
+"""Pair-budget measurement — the arithmetic that gates baseline parity.
+
+docs/TUNING.md's work-budget table shows parity needs BOTH a high-MFU
+kernel AND a small pair budget (pairs scored per query). The budget is
+pure prune geometry — a function of (bucket_size, point_group, k) and the
+data distribution, independent of the platform executing it — so it is
+measured here exactly, on the CPU fixture, with the XLA twin's executed
+tile counts (chunk-granular: what a dense engine really pays). The
+wall-clock columns of tpu_tune.py say which geometry runs fastest ON
+CHIP; this report says how much work each geometry does at all.
+
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python tools/pair_budget.py
+
+Writes pair_budget_report.json; one JSON line per cell. PB_N overrides the
+measurement size (default 250k — pairs/query is near size-invariant for
+uniform data at fixed bucket geometry, see the n-sweep rows).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+# anchor the report beside the repo root wherever the script is invoked
+# from — the committed artifact TUNING.md cites must not silently land in
+# some other cwd (PB_OUT overrides for scratch runs)
+_REPORT = os.environ.get("PB_OUT",
+                         os.path.join(_ROOT, "pair_budget_report.json"))
+
+
+def measure(n, k, bucket_size, point_group):
+    import jax.numpy as jnp
+
+    from mpi_cuda_largescaleknn_tpu.core.config import KnnConfig
+    from mpi_cuda_largescaleknn_tpu.models.unordered import UnorderedKNN
+    from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+
+    pts = np.random.default_rng(7).random((n, 3)).astype(np.float32)
+    cfg = KnnConfig(k=k, engine="tiled", bucket_size=bucket_size,
+                    point_group=point_group)
+    model = UnorderedKNN(cfg, mesh=get_mesh(1))
+    out = model.run(pts)
+    assert np.all(np.isfinite(out))
+    st = model.last_stats or {}
+    pe = int(st.get("pair_evals", 0))
+    return {"n": n, "k": k, "bucket_size": bucket_size,
+            "point_group": point_group,
+            "pair_evals": pe,
+            "pairs_per_query": round(pe / n, 1),
+            "tiles": int(st.get("tiles", 0))}
+
+
+def main() -> int:
+    n = int(os.environ.get("PB_N", 250_000))
+    cells = []
+    for k in (8, 100):
+        for b, g in ((512, 1), (256, 1), (128, 1), (64, 1),
+                     (128, 4), (128, 8), (64, 8), (256, 2)):
+            cells.append((n, k, b, g))
+    # size-invariance check rows (k=8, best-guess geometry)
+    for nn in (62_500, 125_000, 500_000):
+        cells.append((nn, 8, 128, 4))
+
+    results = []
+    for cell in cells:
+        try:
+            r = measure(*cell)
+        except Exception as e:  # a failed cell must not lose the report
+            r = {"n": cell[0], "k": cell[1], "bucket_size": cell[2],
+                 "point_group": cell[3],
+                 "error": f"{type(e).__name__}: {e}"[:300]}
+        results.append(r)
+        print(json.dumps(r), flush=True)
+        with open(_REPORT, "w") as f:
+            json.dump(results, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

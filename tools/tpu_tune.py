@@ -88,8 +88,10 @@ def _cells(quick: bool):
             cells.append({"engine": "pallas_tiled", "n": n8, "k": 8,
                           "bucket_size": b, "env": {"LSK_CHUNK_LANES": lanes}})
     # decoupled prune/tile geometry: fine query buckets, coarse point side
-    # (escapes the bucket-size diagonal — docs/TUNING.md point_group row)
-    for b, g in ((128, 4), (128, 8), (256, 2), (256, 4)):
+    # (escapes the bucket-size diagonal — docs/TUNING.md point_group row).
+    # pair_budget_report.json (CPU-measured, platform-independent): at an
+    # equal 512-lane tile, 64/G8 scores ~3x fewer pairs than 512/G1
+    for b, g in ((128, 4), (128, 8), (64, 8), (64, 16), (256, 2)):
         cells.append({"engine": "pallas_tiled", "n": n8, "k": 8,
                       "bucket_size": b, "point_group": g,
                       "env": {"LSK_CHUNK_LANES": "2048"}})
