@@ -33,12 +33,16 @@ from mpi_cuda_largescaleknn_tpu.utils.math import cdiv
 _NEG_BIG = -(2**31) + 1  # int32 "minus infinity" for one-hot id extraction
 
 
-def fold_tile_into_candidates(d2, ids_row, cand_d2, cand_idx):
+def fold_tile_into_candidates(d2, ids_row, cand_d2, cand_idx,
+                              with_passes: bool = False):
     """Fold a distance tile ``f32[S, T]`` into sorted candidate rows.
 
     ``ids_row``: i32[1, T] point ids for the tile's lanes. Returns updated
     (cand_d2, cand_idx), both [S, k]. Pure jnp — usable inside any kernel (or
-    interpreted for tests).
+    interpreted for tests). With ``with_passes`` additionally returns the
+    i32 number of extract-min passes the loop ran — the k-scaling cost
+    center (each pass sweeps the whole tile; a cold row pays up to k+1,
+    a warm-started row 1-3 — see ops/tiled.py warm_start_self).
     """
     s, t = d2.shape
     k = cand_d2.shape[1]
@@ -55,7 +59,7 @@ def fold_tile_into_candidates(d2, ids_row, cand_d2, cand_idx):
         return carry[0]
 
     def body(carry):
-        _, d2, cd2, cidx = carry
+        _, d2, cd2, cidx, npass = carry
         m = jnp.min(d2, axis=1)                       # [S]
         improved = m[:, None] < kth(cd2)              # [S, 1]
         # first lane holding the row minimum
@@ -80,11 +84,13 @@ def fold_tile_into_candidates(d2, ids_row, cand_d2, cand_idx):
         cd2 = jnp.where(improved, ins_d2, cd2)
         cidx = jnp.where(improved, ins_idx, cidx)
         go = jnp.any(jnp.min(d2, axis=1)[:, None] < kth(cd2))
-        return go, d2, cd2, cidx
+        return go, d2, cd2, cidx, npass + 1
 
     go0 = jnp.any(jnp.min(d2, axis=1)[:, None] < kth(cand_d2))
-    _, _, cand_d2, cand_idx = jax.lax.while_loop(
-        cond, body, (go0, d2, cand_d2, cand_idx))
+    _, _, cand_d2, cand_idx, npass = jax.lax.while_loop(
+        cond, body, (go0, d2, cand_d2, cand_idx, jnp.int32(0)))
+    if with_passes:
+        return cand_d2, cand_idx, npass
     return cand_d2, cand_idx
 
 

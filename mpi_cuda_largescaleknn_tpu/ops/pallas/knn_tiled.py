@@ -53,7 +53,8 @@ def _kernel(order_ref, boxd2_ref,            # SMEM: [1, 1, Bp] i32 / f32
             in_d2_ref, in_idx_ref,           # VMEM: [S, k]
             p_hbm, pid_hbm,                  # ANY (HBM): [Bp, 4, T] / [Bp, 1, T]
             out_d2_ref, out_idx_ref,         # VMEM: [S, k]
-            vis_ref,                         # SMEM: [1, 1, 1] i32 visits
+            vis_ref,                         # SMEM: [1,1,2] i32 [visits,
+                                             #        fold passes]
             p_buf, id_buf, sem_p, sem_i,     # scratch: [2,4,V*T], [2,1,V*T],
             *, visit_batch, self_group):     #          (2,V), (2,V)
     num_pb = p_hbm.shape[0]
@@ -108,7 +109,7 @@ def _kernel(order_ref, boxd2_ref,            # SMEM: [1, 1, Bp] i32 / f32
     sskip = sskip_ref[0, 0, 0] != 0
 
     def cond(carry):
-        c, cd2, _cidx, _nv = carry
+        c, cd2, _cidx, _nv, _np = carry
         # nearest-first order is ascending in box distance, so if even the
         # chunk's FIRST bucket is beyond every query's radius, all later
         # buckets are too. & does not short-circuit in traced code: clamp
@@ -117,7 +118,7 @@ def _kernel(order_ref, boxd2_ref,            # SMEM: [1, 1, Bp] i32 / f32
         return (c < num_chunks) & (boxd2_ref[0, 0, first] < worst2(cd2))
 
     def body(carry):
-        c, cd2, cidx, nvis = carry
+        c, cd2, cidx, nvis, npass = carry
         slot = lax.rem(c, 2)
 
         @pl.when(c + 1 < num_chunks)
@@ -155,14 +156,15 @@ def _kernel(order_ref, boxd2_ref,            # SMEM: [1, 1, Bp] i32 / f32
             [jnp.full((1, t_p), kv, jnp.bool_) for kv in keep_v], axis=1)
         keep = keep_lane & (lane < n_valid)
         d2 = jnp.where(keep, d2, jnp.inf)
-        cd2, cidx = fold_tile_into_candidates(d2, ids, cd2, cidx)
+        cd2, cidx, dp = fold_tile_into_candidates(d2, ids, cd2, cidx,
+                                                  with_passes=True)
         nvis = nvis + sum((kv & (c * v_b + v < num_pb)).astype(jnp.int32)
                           for v, kv in enumerate(keep_v))
-        return c + 1, cd2, cidx, nvis
+        return c + 1, cd2, cidx, nvis, npass + dp
 
-    c_exit, cd2, cidx, nvis = lax.while_loop(
+    c_exit, cd2, cidx, nvis, npass = lax.while_loop(
         cond, body, (jnp.int32(0), in_d2_ref[:], in_idx_ref[:],
-                     jnp.int32(0)))
+                     jnp.int32(0), jnp.int32(0)))
 
     # a prefetch for chunk c_exit is in flight whenever the loop stopped
     # short of the end (started initially for c=0 or by the body for c+1);
@@ -175,8 +177,11 @@ def _kernel(order_ref, boxd2_ref,            # SMEM: [1, 1, Bp] i32 / f32
     out_idx_ref[:] = cidx
     # buckets this query bucket actually scored (per-visit precision:
     # chunk-tail buckets beyond the entry radius and pad duplicates are
-    # masked before the fold and excluded here)
+    # masked before the fold and excluded here) + extract-min passes its
+    # folds ran (each pass sweeps one whole [S, V*T] chunk — the
+    # k-scaling cost center, see fold_tile_into_candidates)
     vis_ref[0, 0, 0] = nvis
+    vis_ref[0, 0, 1] = npass
 
 
 def _vmem_limit(s_q: int, t_p: int, visit_batch: int, k: int) -> int:
@@ -237,7 +242,7 @@ def _run(order, boxd2, sskip, q_pts, q_ids, in_d2, in_idx, p_t, pid_t, *,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((s_q, k), lambda b: (b, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, 1), lambda b: (b, 0, 0),
+            pl.BlockSpec((1, 1, 2), lambda b: (b, 0, 0),
                          memory_space=pltpu.SMEM),
         ),
         out_shape=(
@@ -249,7 +254,7 @@ def _run(order, boxd2, sskip, q_pts, q_ids, in_d2, in_idx, p_t, pid_t, *,
             jax.ShapeDtypeStruct((num_qb * s_q, k), jnp.int32,
                                  vma=getattr(jax.typeof(in_idx), "vma",
                                              frozenset())),
-            jax.ShapeDtypeStruct((num_qb, 1, 1), jnp.int32,
+            jax.ShapeDtypeStruct((num_qb, 1, 2), jnp.int32,
                                  vma=getattr(jax.typeof(in_idx), "vma",
                                              frozenset())),
         ),
@@ -275,7 +280,7 @@ def _run(order, boxd2, sskip, q_pts, q_ids, in_d2, in_idx, p_t, pid_t, *,
 def knn_update_tiled_pallas(state: CandidateState, q: BucketedPoints,
                             p: BucketedPoints, *,
                             interpret: bool | None = None,
-                            with_stats: bool = False,
+                            with_stats: bool | str = False,
                             visit_batch: int | None = None,
                             skip_self=None, self_group: int = 1):
     """Drop-in Pallas twin of ``ops.tiled.knn_update_tiled`` (same contract:
@@ -283,8 +288,12 @@ def knn_update_tiled_pallas(state: CandidateState, q: BucketedPoints,
     ``with_stats`` additionally returns the i32 count of [S, T] tiles
     scored — here the sum over query buckets of buckets each visited, since
     every bucket advances independently instead of lock-stepping;
-    ``skip_self`` as in the twin: nonzero masks point bucket b out of query
-    bucket b's traversal for warm-started self-joins)."""
+    ``with_stats="full"`` returns ``(out, visits, fold_passes)`` where
+    fold_passes is the summed extract-min pass count — the k-scaling cost
+    the warm start exists to cap, for on-chip diagnosis (tools/tpu_probe);
+    ``skip_self``/``self_group`` as in the twin: nonzero masks point bucket
+    b // self_group out of query bucket b's traversal for warm-started
+    self-joins)."""
     if interpret is None:
         from mpi_cuda_largescaleknn_tpu.ops.pallas import is_tpu_backend
         interpret = not is_tpu_backend()
@@ -330,6 +339,9 @@ def knn_update_tiled_pallas(state: CandidateState, q: BucketedPoints,
                                    visit_batch=visit_batch,
                                    self_group=self_group)
     out = CandidateState(out_d2, out_idx)
+    if with_stats == "full":
+        return (out, jnp.sum(visits[:, :, 0]).astype(jnp.int32),
+                jnp.sum(visits[:, :, 1]).astype(jnp.int32))
     if with_stats:
-        return out, jnp.sum(visits).astype(jnp.int32)
+        return out, jnp.sum(visits[:, :, 0]).astype(jnp.int32)
     return out

@@ -121,7 +121,7 @@ def warm_start_self(q: BucketedPoints, k: int,
 
 def knn_update_tiled(state: CandidateState, q: BucketedPoints,
                      p: BucketedPoints, *, chunk_buckets: int | None = None,
-                     visits_per_step: int = 8, with_stats: bool = False,
+                     visits_per_step: int = 8, with_stats: bool | str = False,
                      skip_self=None, self_group: int = 1):
     """Fold every real point of ``p`` into the candidate state (one
     reference ``runQuery`` launch, at bucket granularity).
@@ -252,4 +252,7 @@ def knn_update_tiled(state: CandidateState, q: BucketedPoints,
     hd2, hidx, _, _, tiles = lax.while_loop(cond, body, init)
     out = CandidateState(hd2.reshape(num_qb * s_q, k),
                          hidx.reshape(num_qb * s_q, k))
+    if with_stats == "full":
+        # width-2k sort-merge, not extract-min: no pass counter exists
+        return out, tiles, tiles0 * 0
     return (out, tiles) if with_stats else out

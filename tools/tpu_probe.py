@@ -11,8 +11,13 @@ line per stage so a hang is attributable, and a final ``PROBE`` summary.
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 import traceback
+
+# `python tools/tpu_probe.py` puts tools/ (not the repo root) on sys.path
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 REPORT = {}
 
@@ -114,6 +119,48 @@ def main():
         out.dist2.block_until_ready()
         return {"compile_s": round(compile_s, 2),
                 "steady_s": round(time.time() - t2, 4)}
+
+    @stage("pallas_warm_group")
+    def _warm_group():
+        # the round-5 kernel additions in one compile: per-visit mask
+        # (concat of broadcast bools), skip_self SMEM scalar, self_group
+        # mapping, [1,1,2] visits/passes output — all must Mosaic-lower
+        from mpi_cuda_largescaleknn_tpu.ops.partition import (
+            coarsen_buckets,
+            partition_points,
+        )
+        from mpi_cuda_largescaleknn_tpu.ops.pallas.knn_tiled import (
+            knn_update_tiled_pallas,
+        )
+        from mpi_cuda_largescaleknn_tpu.ops.candidates import init_candidates
+        from mpi_cuda_largescaleknn_tpu.ops.tiled import warm_start_self
+        import jax.numpy as jnp
+        rng = np.random.default_rng(2)
+        pts = rng.random((8192, 3)).astype(np.float32)
+        out = {}
+        for k in (8, 100):
+            q = partition_points(pts, bucket_size=64)
+            pc = coarsen_buckets(q, 8)           # T = 512 lanes
+            cold = init_candidates(q.num_buckets * q.bucket_size, k)
+            t1 = time.time()
+            ref, vis_c, pas_c = knn_update_tiled_pallas(
+                cold, q, pc, with_stats="full", interpret=not on_tpu)
+            vis_c.block_until_ready()
+            compile_s = time.time() - t1
+            warm0 = warm_start_self(pc, k)
+            got, vis_w, pas_w = knn_update_tiled_pallas(
+                warm0, q, pc, skip_self=jnp.int32(1), self_group=8,
+                with_stats="full", interpret=not on_tpu)
+            # exactness: warm+skip must equal the cold traversal
+            real = np.asarray(q.ids).reshape(-1) >= 0
+            assert np.array_equal(np.asarray(got.dist2)[real],
+                                  np.asarray(ref.dist2)[real])
+            out[f"k{k}"] = {
+                "compile_s": round(compile_s, 2),
+                "fold_passes_cold": int(pas_c),
+                "fold_passes_warm": int(pas_w),
+                "visits_cold": int(vis_c), "visits_warm": int(vis_w)}
+        return out
 
     REPORT["on_tpu"] = bool(on_tpu)
     print("PROBE " + json.dumps(REPORT), flush=True)
