@@ -34,19 +34,34 @@ _NEG_BIG = -(2**31) + 1  # int32 "minus infinity" for one-hot id extraction
 
 
 def fold_tile_into_candidates(d2, ids_row, cand_d2, cand_idx,
-                              with_passes: bool = False):
+                              with_passes: bool = False,
+                              segments: int = 1):
     """Fold a distance tile ``f32[S, T]`` into sorted candidate rows.
 
     ``ids_row``: i32[1, T] point ids for the tile's lanes. Returns updated
     (cand_d2, cand_idx), both [S, k]. Pure jnp — usable inside any kernel (or
     interpreted for tests). With ``with_passes`` additionally returns the
-    i32 number of extract-min passes the loop ran — the k-scaling cost
-    center (each pass sweeps the whole tile; a cold row pays up to k+1,
-    a warm-started row 1-3 — see ops/tiled.py warm_start_self).
+    i32 number of tile-scan passes the loop ran — the k-scaling cost
+    center (each pass sweeps the whole tile; a cold row pays up to ~k
+    passes at segments=1, a warm-started row 1-3 — see ops/tiled.py
+    warm_start_self).
+
+    ``segments`` (static, must divide T): each pass extracts the minimum
+    of EACH lane segment and inserts up to ``segments`` candidates per
+    row, so the pass count drops by up to that factor — the lever that
+    makes k=100 affordable (adoptions per chunk scale with k; tile scans
+    are the expensive part, the [S, k] inserts are cheap). The final
+    content is IDENTICAL to segments=1: inserting into a sorted row is
+    order-independent for the kept set, and segment order equals lane
+    order, so strict-< boundary ties resolve to the same (lowest-lane)
+    winner the global extract-min picks.
     """
     s, t = d2.shape
     k = cand_d2.shape[1]
-    lane = jax.lax.broadcasted_iota(jnp.int32, (s, t), 1)
+    nseg = max(1, segments)
+    assert t % nseg == 0, (t, nseg)
+    w = t // nseg
+    lane_w = jax.lax.broadcasted_iota(jnp.int32, (s, w), 1)
     cols = jax.lax.broadcasted_iota(jnp.int32, (s, k), 1)
     ids_b = jnp.broadcast_to(ids_row, (s, t))
 
@@ -55,21 +70,7 @@ def fold_tile_into_candidates(d2, ids_row, cand_d2, cand_idx,
         # dynamic_slice, which Mosaic's TPU lowering rejects
         return jax.lax.slice_in_dim(cd2, k - 1, k, axis=1)      # [S, 1]
 
-    def cond(carry):
-        return carry[0]
-
-    def body(carry):
-        _, d2, cd2, cidx, npass = carry
-        m = jnp.min(d2, axis=1)                       # [S]
-        improved = m[:, None] < kth(cd2)              # [S, 1]
-        # first lane holding the row minimum
-        is_min = d2 == m[:, None]
-        ml = jnp.min(jnp.where(is_min, lane, t), axis=1)
-        sel = is_min & (lane == ml[:, None])
-        mid = jnp.max(jnp.where(sel, ids_b, _NEG_BIG), axis=1)
-        # consume the extracted lane
-        d2 = jnp.where(sel & improved, jnp.inf, d2)
-
+    def insert(cd2, cidx, m, mid, improved):
         # sorted insert: after any equal entries (stable, existing first);
         # right-shift by one (the shifted col 0 is never selected: col > pos
         # is impossible at col 0)
@@ -77,12 +78,34 @@ def fold_tile_into_candidates(d2, ids_row, cand_d2, cand_idx,
         roll_d2 = jnp.concatenate([cd2[:, :1], cd2[:, :-1]], axis=1)
         roll_idx = jnp.concatenate([cidx[:, :1], cidx[:, :-1]], axis=1)
         ins_d2 = jnp.where(cols < pos[:, None], cd2,
-                           jnp.where(cols == pos[:, None], m[:, None], roll_d2))
+                           jnp.where(cols == pos[:, None], m[:, None],
+                                     roll_d2))
         ins_idx = jnp.where(cols < pos[:, None], cidx,
                             jnp.where(cols == pos[:, None], mid[:, None],
                                       roll_idx))
-        cd2 = jnp.where(improved, ins_d2, cd2)
-        cidx = jnp.where(improved, ins_idx, cidx)
+        return (jnp.where(improved, ins_d2, cd2),
+                jnp.where(improved, ins_idx, cidx))
+
+    def cond(carry):
+        return carry[0]
+
+    def body(carry):
+        _, d2, cd2, cidx, npass = carry
+        blocks = []
+        for sg in range(nseg):                        # static unroll
+            blk = jax.lax.slice_in_dim(d2, sg * w, (sg + 1) * w, axis=1)
+            idb = jax.lax.slice_in_dim(ids_b, sg * w, (sg + 1) * w, axis=1)
+            m = jnp.min(blk, axis=1)                  # [S]
+            improved = m[:, None] < kth(cd2)          # [S, 1]
+            # first lane holding the segment minimum
+            is_min = blk == m[:, None]
+            ml = jnp.min(jnp.where(is_min, lane_w, w), axis=1)
+            sel = is_min & (lane_w == ml[:, None])
+            mid = jnp.max(jnp.where(sel, idb, _NEG_BIG), axis=1)
+            # consume the extracted lane
+            blocks.append(jnp.where(sel & improved, jnp.inf, blk))
+            cd2, cidx = insert(cd2, cidx, m, mid, improved)
+        d2 = blocks[0] if nseg == 1 else jnp.concatenate(blocks, axis=1)
         go = jnp.any(jnp.min(d2, axis=1)[:, None] < kth(cd2))
         return go, d2, cd2, cidx, npass + 1
 

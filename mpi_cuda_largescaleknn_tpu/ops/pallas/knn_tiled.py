@@ -56,7 +56,8 @@ def _kernel(order_ref, boxd2_ref,            # SMEM: [1, 1, Bp] i32 / f32
             vis_ref,                         # SMEM: [1,1,2] i32 [visits,
                                              #        fold passes]
             p_buf, id_buf, sem_p, sem_i,     # scratch: [2,4,V*T], [2,1,V*T],
-            *, visit_batch, self_group):     #          (2,V), (2,V)
+            *, visit_batch, self_group,      #          (2,V), (2,V)
+            fold_segments):
     num_pb = p_hbm.shape[0]
     t_p = p_hbm.shape[2]
     v_b = visit_batch
@@ -157,7 +158,8 @@ def _kernel(order_ref, boxd2_ref,            # SMEM: [1, 1, Bp] i32 / f32
         keep = keep_lane & (lane < n_valid)
         d2 = jnp.where(keep, d2, jnp.inf)
         cd2, cidx, dp = fold_tile_into_candidates(d2, ids, cd2, cidx,
-                                                  with_passes=True)
+                                                  with_passes=True,
+                                                  segments=fold_segments)
         nvis = nvis + sum((kv & (c * v_b + v < num_pb)).astype(jnp.int32)
                           for v, kv in enumerate(keep_v))
         return c + 1, cd2, cidx, nvis, npass + dp
@@ -177,9 +179,10 @@ def _kernel(order_ref, boxd2_ref,            # SMEM: [1, 1, Bp] i32 / f32
     out_idx_ref[:] = cidx
     # buckets this query bucket actually scored (per-visit precision:
     # chunk-tail buckets beyond the entry radius and pad duplicates are
-    # masked before the fold and excluded here) + extract-min passes its
-    # folds ran (each pass sweeps one whole [S, V*T] chunk — the
-    # k-scaling cost center, see fold_tile_into_candidates)
+    # masked before the fold and excluded here) + tile-scan passes its
+    # folds ran (each pass sweeps one whole [S, V*T] chunk and adopts up
+    # to fold_segments candidates — the k-scaling cost center, see
+    # fold_tile_into_candidates)
     vis_ref[0, 0, 0] = nvis
     vis_ref[0, 0, 1] = npass
 
@@ -205,16 +208,17 @@ def _vmem_limit(s_q: int, t_p: int, visit_batch: int, k: int) -> int:
 
 
 @functools.partial(jax.jit, static_argnames=("interpret", "visit_batch",
-                                             "self_group"))
+                                             "self_group", "fold_segments"))
 def _run(order, boxd2, sskip, q_pts, q_ids, in_d2, in_idx, p_t, pid_t, *,
-         interpret, visit_batch, self_group):
+         interpret, visit_batch, self_group, fold_segments):
     num_qb, s_q, _one = q_ids.shape
     num_pb, _, t_p = p_t.shape
     k = in_d2.shape[-1]
     grid = (num_qb,)
     out_d2, out_idx, visits = pl.pallas_call(
         functools.partial(_kernel, visit_batch=visit_batch,
-                          self_group=self_group),
+                          self_group=self_group,
+                          fold_segments=fold_segments),
         grid=grid,
         in_specs=[
             # Mosaic requires the LAST TWO block dims to be sublane/lane
@@ -289,8 +293,11 @@ def knn_update_tiled_pallas(state: CandidateState, q: BucketedPoints,
     scored — here the sum over query buckets of buckets each visited, since
     every bucket advances independently instead of lock-stepping;
     ``with_stats="full"`` returns ``(out, visits, fold_passes)`` where
-    fold_passes is the summed extract-min pass count — the k-scaling cost
-    the warm start exists to cap, for on-chip diagnosis (tools/tpu_probe);
+    fold_passes is the summed TILE-SCAN count of the fold loops (each scan
+    adopts up to ``fold_segments`` candidates — compare runs only at equal
+    segment settings) — the k-scaling cost the warm start and the
+    multi-extract fold exist to cap, for on-chip diagnosis
+    (tools/tpu_probe);
     ``skip_self``/``self_group`` as in the twin: nonzero masks point bucket
     b // self_group out of query bucket b's traversal for warm-started
     self-joins)."""
@@ -330,6 +337,20 @@ def knn_update_tiled_pallas(state: CandidateState, q: BucketedPoints,
         lanes = int(os.environ.get("LSK_CHUNK_LANES", 2048))
         visit_batch = max(1, lanes // p_t.shape[2])
     visit_batch = min(visit_batch, p_t.shape[0])
+    # multi-extract fold segments: adoptions per chunk scale with k, tile
+    # scans are the expensive part — at k>=32 extract one min per 128-lane
+    # segment per pass (fold_tile_into_candidates). LSK_FOLD_SEGS
+    # overrides (trace-time, like LSK_CHUNK_LANES)
+    lanes_total = visit_batch * p_t.shape[2]
+    fold_segs = int(os.environ.get("LSK_FOLD_SEGS", 0))
+    if fold_segs <= 0:
+        fold_segs = (max(1, min(lanes_total // 128, 16))
+                     if k >= 32 else 1)
+    # sanitize the env override at the read site: clamp to the lane count
+    # and round down to a divisor (a bad sweep value must tune, not crash)
+    fold_segs = max(1, min(fold_segs, lanes_total // 128))
+    while lanes_total % fold_segs:
+        fold_segs -= 1
     ss = jnp.asarray(0 if skip_self is None else skip_self,
                      jnp.int32).reshape(1, 1, 1)
     out_d2, out_idx, visits = _run(order[:, None, :], sorted_d2[:, None, :],
@@ -337,7 +358,8 @@ def knn_update_tiled_pallas(state: CandidateState, q: BucketedPoints,
                                    state.dist2, state.idx, p_t, pid_t,
                                    interpret=interpret,
                                    visit_batch=visit_batch,
-                                   self_group=self_group)
+                                   self_group=self_group,
+                                   fold_segments=fold_segs)
     out = CandidateState(out_d2, out_idx)
     if with_stats == "full":
         return (out, jnp.sum(visits[:, :, 0]).astype(jnp.int32),

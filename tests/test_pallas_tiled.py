@@ -133,3 +133,32 @@ def test_demand_pallas_tiled_matches_oracle():
     model = PrePartitionedKNN(cfg, mesh=get_mesh(8))
     got = np.concatenate(model.run(parts))
     assert_dist_equal(got, kth_nn_dist(pts, pts, 5))
+
+
+def test_fold_segments_bitidentical():
+    """Multi-extract fold (segments>1) must produce byte-identical candidate
+    rows to the global extract-min (segments=1), including boundary ties
+    from duplicated points."""
+    import jax.numpy as jnp
+
+    from mpi_cuda_largescaleknn_tpu.ops.pallas.knn_bf import (
+        fold_tile_into_candidates,
+    )
+
+    rng = np.random.default_rng(8)
+    s, t, k = 16, 512, 100
+    d2 = rng.random((s, t)).astype(np.float32)
+    d2[:, 128:256] = d2[:, :128]          # exact value ties across segments
+    ids = np.arange(t, dtype=np.int32)[None, :]
+    cd2 = np.full((s, k), np.inf, np.float32)
+    cidx = np.full((s, k), -1, np.int32)
+    base_d2, base_idx, base_p = fold_tile_into_candidates(
+        jnp.asarray(d2), jnp.asarray(ids), jnp.asarray(cd2),
+        jnp.asarray(cidx), with_passes=True, segments=1)
+    for nseg in (2, 4, 16):
+        g_d2, g_idx, g_p = fold_tile_into_candidates(
+            jnp.asarray(d2), jnp.asarray(ids), jnp.asarray(cd2),
+            jnp.asarray(cidx), with_passes=True, segments=nseg)
+        np.testing.assert_array_equal(np.asarray(g_d2), np.asarray(base_d2))
+        np.testing.assert_array_equal(np.asarray(g_idx), np.asarray(base_idx))
+        assert int(g_p) < int(base_p), (nseg, int(g_p), int(base_p))
