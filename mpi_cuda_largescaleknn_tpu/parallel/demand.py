@@ -138,9 +138,11 @@ def _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
     use_tiled = engine in ("tiled", "auto", "pallas_tiled")
     update = None if use_tiled else _engine_fn(engine, query_tile, point_tile)
     tiled_update = _tiled_engine_fn(engine) if use_tiled else None
-    # warm start needs query bucket b == resident bucket b in round 0, i.e.
-    # the self-join init path on one shared partition (see ring.py)
-    warm_start = warm_start and use_tiled
+    # warm start needs query bucket b == resident bucket b in round 0 (the
+    # self-join init path on one shared partition) and pays only where
+    # fold passes are the cost — the Pallas kernel, not the sort-merge
+    # twin (measured regression on the twin: see ring.py _make_ring_fns)
+    warm_start = warm_start and engine == "pallas_tiled"
     use_tree = engine == "tree"
     fwd = [(i, (i + 1) % num_shards) for i in range(num_shards)]
     bwd = [(i, (i - 1) % num_shards) for i in range(num_shards)]

@@ -209,9 +209,14 @@ def _make_ring_fns(k, max_radius, engine, query_tile, point_tile, bucket_size,
     """
     use_tiled = engine in ("tiled", "auto", "pallas_tiled")
     # warm start only applies to self-joins on ONE shared partition (query
-    # bucket b IS point bucket b in round 0) — the tiled drivers; chunked
-    # drivers partition queries separately and must stay cold
-    warm_start = warm_start and use_tiled
+    # bucket b IS point bucket b in round 0) — and only pays where the
+    # fold's PASS count is the cost: the Pallas kernel. The XLA twin's
+    # width-2k sort-merge saves nothing from a warm heap, and the warm
+    # start's own top_k+merge cost REGRESSED it 20% at 500K/k=100 on the
+    # CPU fixture (round-5 A/B vs the round-4 tree) — so the twin stays
+    # cold. Chunked drivers partition queries separately and always stay
+    # cold.
+    warm_start = warm_start and engine == "pallas_tiled"
     fwd = [(i, (i + 1) % num_shards) for i in range(num_shards)]
     bwd = [(i, (i - 1) % num_shards) for i in range(num_shards)]
 
@@ -378,8 +383,9 @@ def _warm_tiles(engine: str, npad_local: int, bucket_size: int,
     """[S, S] tiles the warm start scores (one per bucket, every device) —
     counted into executed-work stats alongside the kernel's measured tile
     counts, since warm_start_self does that distance work in XLA before
-    the traversal ever runs (self-join drivers only)."""
-    if engine not in ("tiled", "auto", "pallas_tiled"):
+    the traversal ever runs (pallas_tiled self-join drivers only — the
+    twin stays cold, see _make_ring_fns)."""
+    if engine != "pallas_tiled":
         return 0
     return num_shards * choose_buckets(npad_local, bucket_size)[0]
 
@@ -636,9 +642,13 @@ def ring_knn_stepwise(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
         out += (CandidateState(hd2, hidx),)
     if return_stats:
         tiles_total = int(np.sum([np.asarray(t).sum() for t in tiles_parts]))
-        if start == 0:
+        if not resuming:
             # the warm start ran in THIS session (a resumed run's heap
-            # already carries it — its tiles belong to the first session)
+            # already carries it — its tiles belong to the first session).
+            # Guarded on the same flag that gated the warm start, NOT on
+            # start == 0: a checkpoint that passes peek_round but vanishes
+            # before load leaves start at 0 with a COLD round 0, and the
+            # kernel then counts the self-bucket tiles itself
             tiles_total += _warm_tiles(engine, npad_local, bucket_size,
                                        num_shards)
         # analytic fold count for flat engines, exact for resumed
