@@ -23,7 +23,8 @@ TPU-side options (no reference analogue):
                     pallas_tiled is its fused-kernel form for real TPUs)
   --query-tile N    queries per inner tile (flat engines; default 2048)
   --point-tile N    tree points per inner tile (flat engines; default 2048)
-  --bucket-size N   points per spatial bucket (tiled engine; default 512)
+  --bucket-size N   points per spatial bucket (tiled engines; default
+                    auto: engine-tuned, see docs/TUNING.md)
   --point-group N   coarsen the resident point side by this power-of-two
                     factor (tiled self-join drivers; default 1; not
                     combinable with --query-chunk)
@@ -67,7 +68,7 @@ def parse_args(program: str, argv: list[str]):
     in_path = ""
     out_path = ""
     extras = {"shards": None, "engine": "auto", "query_tile": 2048,
-              "point_tile": 2048, "bucket_size": 512, "point_group": 1,
+              "point_tile": 2048, "bucket_size": 0, "point_group": 1,
               "profile_dir": None,
               "timings": False, "checkpoint_dir": None, "checkpoint_every": 1,
               "write_indices": None, "query_chunk": 0, "selfcheck": 0,

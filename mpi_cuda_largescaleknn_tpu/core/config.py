@@ -28,7 +28,11 @@ class KnnConfig:
                                      # | "bruteforce" | "tree" | "pallas"
     query_tile: int = 2048           # queries processed per inner tile
     point_tile: int = 2048           # tree points per inner tile
-    bucket_size: int = 512           # tiled engine: points per spatial bucket
+    bucket_size: int = 0             # tiled engines: points per spatial
+                                     # bucket; 0 = auto per engine from
+                                     # measured data (parallel/ring.py
+                                     # resolve_bucket_size: twin 128,
+                                     # pallas 512)
     point_group: int = 1             # tiled self-join drivers: coarsen the
                                      # point side by this power-of-two factor
                                      # (fine query buckets -> tighter prune

@@ -91,6 +91,7 @@ from mpi_cuda_largescaleknn_tpu.parallel.ring import (
     _engine_fn,
     _tiled_engine_fn,
     partition_sharded,
+    resolve_bucket_size,
     resolve_engine,
     ring_total_rounds,
 )
@@ -331,7 +332,7 @@ demand_total_rounds = ring_total_rounds
 def demand_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
                mesh, *, max_radius: float = jnp.inf,
                engine: str = "auto", query_tile: int = 2048,
-               point_tile: int = 2048, bucket_size: int = 512,
+               point_tile: int = 2048, bucket_size: int = 0,
                point_group: int = 1, return_stats: bool = False):
     """Bounds-pruned kNN over pre-partitioned shards on a 1-D mesh (fused
     on-device ``lax.while_loop``).
@@ -342,6 +343,7 @@ def demand_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
     reference only exposes as per-round stdout prints (:306).
     """
     engine = resolve_engine(engine)
+    bucket_size = resolve_bucket_size(bucket_size, engine)
     num_shards = mesh.shape[AXIS]
     npad = points_sharded.shape[0] // num_shards
     point_group = _effective_group(point_group, npad, bucket_size)
@@ -415,7 +417,7 @@ def demand_knn_stepwise(points_sharded: jnp.ndarray,
                         ids_sharded: jnp.ndarray, k: int, mesh, *,
                         max_radius: float = jnp.inf, engine: str = "auto",
                         query_tile: int = 2048, point_tile: int = 2048,
-                        bucket_size: int = 512, point_group: int = 1,
+                        bucket_size: int = 0, point_group: int = 1,
                         checkpoint_dir: str | None = None,
                         checkpoint_every: int = 1,
                         max_rounds: int | None = None,
@@ -431,6 +433,7 @@ def demand_knn_stepwise(points_sharded: jnp.ndarray,
     from mpi_cuda_largescaleknn_tpu.utils import checkpoint as ckpt
 
     engine = resolve_engine(engine)
+    bucket_size = resolve_bucket_size(bucket_size, engine)
     num_shards = mesh.shape[AXIS]
     npad = points_sharded.shape[0] // num_shards
     point_group = _effective_group(point_group, npad, bucket_size)
@@ -543,7 +546,7 @@ def demand_knn_chunked(points_sharded: jnp.ndarray,
                        ids_sharded: jnp.ndarray, k: int, mesh, *,
                        chunk_rows: int, max_radius: float = jnp.inf,
                        engine: str = "auto", query_tile: int = 2048,
-                       point_tile: int = 2048, bucket_size: int = 512,
+                       point_tile: int = 2048, bucket_size: int = 0,
                        checkpoint_dir: str | None = None,
                        checkpoint_every: int = 1,
                        return_candidates: bool = False,
@@ -569,6 +572,7 @@ def demand_knn_chunked(points_sharded: jnp.ndarray,
     from mpi_cuda_largescaleknn_tpu.utils import checkpoint as ckpt
 
     engine = resolve_engine(engine)
+    bucket_size = resolve_bucket_size(bucket_size, engine)
     num_shards = mesh.shape[AXIS]
     (_ifn, round_fn, final_fn, shard_init_fn, query_init_fn, _ifq,
      query_init_from_q) = \

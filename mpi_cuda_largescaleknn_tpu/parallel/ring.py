@@ -159,6 +159,24 @@ def resolve_engine(engine: str) -> str:
     return "pallas_tiled"
 
 
+def resolve_bucket_size(bucket_size: int, engine: str) -> int:
+    """0 = auto, resolved per engine from measured data: the XLA twin is
+    pair-budget-bound on its low-overhead backend (CPU wall-clock tracks
+    pairs/query 1:1 — bucket 128 doubled 250K/k=8 throughput over 512,
+    round-5 geometry sweep + pair_budget_report.json), while the Pallas
+    kernel pays a real per-while-step cost that favors wider tiles — it
+    keeps 512 until tpu_tune.py's on-chip data says otherwise.
+
+    Checkpoint note: stepwise fingerprints record the RESOLVED value (a
+    different bucket geometry is genuinely non-resumable state — the
+    partitioned shard arrays change shape), so changing an auto default
+    here makes older default-flag checkpoints resumable only by passing
+    the explicit --bucket-size the fingerprint error names."""
+    if bucket_size:
+        return bucket_size
+    return 128 if engine == "tiled" else 512
+
+
 def _tiled_engine_fn(engine: str):
     """Bucket-granular fold for the tiled data path: the fused Pallas
     traversal kernel for ``pallas_tiled``, the XLA twin otherwise."""
@@ -433,7 +451,7 @@ def _ring_stats(engine: str, tiles_total: int, bucket_size: int,
 def ring_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
              mesh, *, max_radius: float = jnp.inf, engine: str = "auto",
              query_tile: int = 2048, point_tile: int = 2048,
-             bucket_size: int = 512, point_group: int = 1,
+             bucket_size: int = 0, point_group: int = 1,
              return_candidates: bool = False,
              return_stats: bool = False):
     """Run the full R-round ring on a 1-D mesh (fused ``lax.fori_loop``).
@@ -453,6 +471,7 @@ def ring_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
       padding rows), plus the CandidateState if ``return_candidates``.
     """
     engine = resolve_engine(engine)
+    bucket_size = resolve_bucket_size(bucket_size, engine)
     num_shards = mesh.shape[AXIS]
     total_rounds = ring_total_rounds(num_shards)
     npad_local = points_sharded.shape[0] // num_shards
@@ -530,7 +549,7 @@ def ring_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
 def ring_knn_stepwise(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
                       k: int, mesh, *, max_radius: float = jnp.inf,
                       engine: str = "auto", query_tile: int = 2048,
-                      point_tile: int = 2048, bucket_size: int = 512,
+                      point_tile: int = 2048, bucket_size: int = 0,
                       point_group: int = 1,
                       checkpoint_dir: str | None = None,
                       checkpoint_every: int = 1,
@@ -558,6 +577,7 @@ def ring_knn_stepwise(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
     from mpi_cuda_largescaleknn_tpu.utils import checkpoint as ckpt
 
     engine = resolve_engine(engine)
+    bucket_size = resolve_bucket_size(bucket_size, engine)
     num_shards = mesh.shape[AXIS]
     spec = P(AXIS)
     check_vma = not engine.startswith("pallas")
@@ -666,7 +686,7 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
                      k: int, mesh, *, chunk_rows: int,
                      max_radius: float = jnp.inf, engine: str = "auto",
                      query_tile: int = 2048, point_tile: int = 2048,
-                     bucket_size: int = 512,
+                     bucket_size: int = 0,
                      checkpoint_dir: str | None = None,
                      checkpoint_every: int = 1,
                      max_chunks: int | None = None,
@@ -698,6 +718,7 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
     from mpi_cuda_largescaleknn_tpu.utils import checkpoint as ckpt
 
     engine = resolve_engine(engine)
+    bucket_size = resolve_bucket_size(bucket_size, engine)
     num_shards = mesh.shape[AXIS]
     _init, round_fn, final_fn, shard_init_fn, query_init_fn, _ifq, \
         query_from_q = _make_ring_fns(
@@ -895,7 +916,7 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
 
 
 def measure_exchange_bandwidth(mesh, npad_local: int, *, reps: int = 10,
-                               bucket_size: int = 512,
+                               bucket_size: int = 0,
                                engine: str = "auto") -> dict:
     """MEASURED per-round ring-rotation bandwidth (not analytic).
 
@@ -914,6 +935,7 @@ def measure_exchange_bandwidth(mesh, npad_local: int, *, reps: int = 10,
     import time as _time
 
     engine = resolve_engine(engine)
+    bucket_size = resolve_bucket_size(bucket_size, engine)
     num_shards = mesh.shape[AXIS]
     use_tiled = engine in ("tiled", "auto", "pallas_tiled")
     if use_tiled:
