@@ -43,7 +43,8 @@ rng = np.random.default_rng(11)
 pts = rng.random((n, 3)).astype(np.float32)
 cfg = KnnConfig(k=k, engine=spec.get("engine", "auto"),
                 query_chunk=spec.get("query_chunk", 0),
-                bucket_size=spec.get("bucket_size", 512))
+                # 0 = engine-aware auto (ring.resolve_bucket_size)
+                bucket_size=spec.get("bucket_size", 0))
 mesh = get_mesh(shards)
 
 extra = {}

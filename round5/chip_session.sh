@@ -1,0 +1,68 @@
+#!/bin/bash
+# Round-5 on-chip agenda, in strict priority order (VERDICT.md "Next round").
+# The axon tunnel is single-client: this script is the ONLY TPU-touching
+# process while it runs. Every step logs to round5/chip/ and is individually
+# timeout-capped so one hang cannot eat the window.
+#
+#   bash round5/chip_session.sh            # full agenda
+#   bash round5/chip_session.sh probe      # just the probe
+set -u
+cd /root/repo
+OUT=round5/chip
+mkdir -p $OUT
+stamp() { date -u +%FT%TZ; }
+log() { echo "[$(stamp)] $*" | tee -a $OUT/session.log; }
+
+run_step() { # name timeout_s cmd...
+  local name=$1 tmo=$2; shift 2
+  log "START $name"
+  timeout "$tmo" "$@" > "$OUT/$name.out" 2> "$OUT/$name.err"
+  local rc=$?
+  log "END $name rc=$rc"
+  return $rc
+}
+
+only=${1:-all}
+
+# 1. Probe: health + Mosaic-compile of every round-5 kernel addition
+#    (per-visit mask, skip-self, self_group, [1,1,2] stats, segmented fold).
+#    tpu_probe.py always exits 0 (stage errors go into its report), so the
+#    REAL gate is its on_tpu verdict: a CPU-fallback session must not burn
+#    the tune budget producing numbers step 5 would misread as on-chip.
+if [ "$only" = all ] || [ "$only" = probe ]; then
+  run_step probe 1800 python -u tools/tpu_probe.py || exit 1
+  grep -q '"on_tpu": true' $OUT/probe.out || {
+    log "probe reports on_tpu=false — aborting agenda (CPU backend)";
+    exit 1; }
+fi
+[ "$only" = probe ] && exit 0
+
+# 2. THE deliverable: BENCH at 1M/k=8 on the chip (VERDICT item 1).
+#    bench.py self-checks and falls back with stage attribution.
+if [ "$only" = all ] || [ "$only" = bench ]; then
+  run_step bench_1m_k8 2400 env BENCH_BUDGET_S=1800 python bench.py
+  cp $OUT/bench_1m_k8.out $OUT/BENCH_candidate.json 2>/dev/null
+fi
+[ "$only" = bench ] && exit 0
+
+# 3. Tune sweep (VERDICT item 2): crossed geometry grid at 500K + 1M
+#    confirms; checkpoints tpu_tune_report.json after every cell.
+if [ "$only" = all ] || [ "$only" = tune ]; then
+  run_step tune 14400 python -u tools/tpu_tune.py
+fi
+[ "$only" = tune ] && exit 0
+
+# 4. k=100 on chip (VERDICT item 4): bench at the reference's canonical k.
+if [ "$only" = all ] || [ "$only" = k100 ]; then
+  run_step bench_1m_k100 2400 env BENCH_K=100 BENCH_BUDGET_S=1800 \
+      python bench.py
+fi
+
+# 5. Re-bench 1M/k=8 with the tune winner (read tpu_tune_report.json by
+#    hand and export BENCH_BUCKET_SIZE/BENCH_POINT_GROUP/LSK_CHUNK_LANES
+#    before invoking: bash round5/chip_session.sh best).
+if [ "$only" = best ]; then
+  run_step bench_best 2400 env BENCH_BUDGET_S=1800 python bench.py
+fi
+
+log "agenda complete"

@@ -1,0 +1,32 @@
+#!/bin/bash
+# Retry TPU contact; on success, immediately run the full chip agenda
+# (round5/chip_session.sh) so no tunnel-up minute is wasted.
+# Single instance only (the axon tunnel is single-client).
+LOCK=/root/repo/round5/.watch.lock
+exec 9>"$LOCK"
+flock -n 9 || { echo "another watcher holds $LOCK" >&2; exit 1; }
+LOG=/root/repo/round5/tunnel_watch.log
+echo "watch start $(date -u +%FT%TZ)" >> $LOG
+while true; do
+  # rc=0 ONLY for a real accelerator: a fast CPU fallback (plugin error
+  # instead of tunnel hang) must keep the watcher alive, not fire the
+  # one-shot agenda on the host backend
+  timeout 300 python -c "
+import sys, time, jax
+t0=time.time()
+ds = jax.devices()
+print('CONTACT', round(time.time()-t0,1), [str(d) for d in ds],
+      ds[0].device_kind)
+sys.exit(0 if ds and ds[0].platform != 'cpu' else 2)
+" >> $LOG 2>&1
+  rc=$?
+  echo "attempt rc=$rc $(date -u +%FT%TZ)" >> $LOG
+  if [ $rc -eq 0 ]; then
+    touch /root/repo/round5/TUNNEL_UP
+    echo "TUNNEL UP -> launching chip agenda $(date -u +%FT%TZ)" >> $LOG
+    bash /root/repo/round5/chip_session.sh all >> $LOG 2>&1
+    echo "chip agenda exited $(date -u +%FT%TZ)" >> $LOG
+    exit 0
+  fi
+  sleep 45
+done
