@@ -26,8 +26,8 @@ TPU-side options (no reference analogue):
   --bucket-size N   points per spatial bucket (tiled engines; default
                     auto: engine-tuned, see docs/TUNING.md)
   --point-group N   coarsen the resident point side by this power-of-two
-                    factor (tiled self-join drivers; default 1; not
-                    combinable with --query-chunk)
+                    factor (tiled engines; default 1; chunked runs coarsen
+                    the resident side only)
   --query-chunk N   stream queries in chunks of N rows per device;
                     bounds candidate-heap memory to N*k per device for runs
                     whose heaps exceed HBM (e.g. -k 100 at 100M+ points)

@@ -125,14 +125,16 @@ def run_unordered_multihost(cfg: KnnConfig, in_path: str, out_path: str,
             flat_g, ids_g, cfg.k, mesh, chunk_rows=cfg.query_chunk,
             max_radius=cfg.max_radius, engine=cfg.engine,
             query_tile=cfg.query_tile, point_tile=cfg.point_tile,
-            bucket_size=cfg.bucket_size, checkpoint_dir=cfg.checkpoint_dir,
+            bucket_size=cfg.bucket_size, point_group=cfg.point_group,
+            checkpoint_dir=cfg.checkpoint_dir,
             checkpoint_every=cfg.checkpoint_every)
     else:
         dists = ring_knn(flat_g, ids_g, cfg.k, mesh,
                          max_radius=cfg.max_radius, engine=cfg.engine,
                          query_tile=cfg.query_tile,
                          point_tile=cfg.point_tile,
-                         bucket_size=cfg.bucket_size)
+                         bucket_size=cfg.bucket_size,
+                         point_group=cfg.point_group)
         local_rows = {int(sh.index[0].start) // npad:
                       np.asarray(sh.data).reshape(-1)
                       for sh in dists.addressable_shards}
@@ -213,7 +215,8 @@ def run_prepartitioned_multihost(cfg: KnnConfig, in_path: str,
     dists = demand_knn(flat_g, ids_g, cfg.k, mesh,
                        max_radius=cfg.max_radius, engine=cfg.engine,
                        query_tile=cfg.query_tile, point_tile=cfg.point_tile,
-                       bucket_size=cfg.bucket_size)
+                       bucket_size=cfg.bucket_size,
+                       point_group=cfg.point_group)
 
     local_rows = {int(sh.index[0].start) // npad:
                   np.asarray(sh.data).reshape(-1)

@@ -58,10 +58,3 @@ class KnnConfig:
         if pg < 1 or (pg & (pg - 1)) != 0:
             raise ValueError(
                 f"point_group must be a power of two >= 1, got {pg}")
-        if pg > 1 and self.query_chunk > 0:
-            # chunked queries are partitioned per chunk: there is no
-            # self-join bucket correspondence for the coarsening to use —
-            # fail loudly rather than silently ignore the knob
-            raise ValueError(
-                "point_group > 1 is not supported with query_chunk "
-                "(chunked queries have no self-join bucket correspondence)")

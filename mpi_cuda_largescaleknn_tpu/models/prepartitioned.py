@@ -74,12 +74,12 @@ class PrePartitionedKNN:
                 run_fn = demand_knn_chunked
                 kwargs["chunk_rows"] = cfg.query_chunk
                 kwargs["return_candidates"] = return_neighbors
-                # chunked queries are partitioned per chunk: no self-join
-                # correspondence, so the coarsening knob does not apply
             else:
                 run_fn = (demand_knn_stepwise if cfg.checkpoint_dir
                           else demand_knn)
-                kwargs["point_group"] = cfg.point_group
+            # chunked drivers coarsen only the resident side (no self-join
+            # correspondence for warm start/skip — see ring_knn_chunked)
+            kwargs["point_group"] = cfg.point_group
             dists, cands, stats = run_fn(
                 flat, ids, cfg.k, self.mesh, max_radius=cfg.max_radius,
                 engine=cfg.engine, query_tile=cfg.query_tile,

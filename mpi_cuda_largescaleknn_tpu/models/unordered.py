@@ -79,6 +79,7 @@ class UnorderedKNN:
                     flat, ids, cfg.k, self.mesh, max_radius=cfg.max_radius,
                     engine=cfg.engine, query_tile=cfg.query_tile,
                     point_tile=cfg.point_tile, bucket_size=cfg.bucket_size,
+                    point_group=cfg.point_group,
                     chunk_rows=cfg.query_chunk,
                     checkpoint_dir=cfg.checkpoint_dir,
                     checkpoint_every=cfg.checkpoint_every,

@@ -62,6 +62,8 @@ checkpointable driver (``demand_knn_stepwise``) share one set of builders
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -547,6 +549,7 @@ def demand_knn_chunked(points_sharded: jnp.ndarray,
                        chunk_rows: int, max_radius: float = jnp.inf,
                        engine: str = "auto", query_tile: int = 2048,
                        point_tile: int = 2048, bucket_size: int = 0,
+                       point_group: int = 1,
                        checkpoint_dir: str | None = None,
                        checkpoint_every: int = 1,
                        return_candidates: bool = False,
@@ -598,8 +601,14 @@ def demand_knn_chunked(points_sharded: jnp.ndarray,
     if query_init_from_q is not None:
         # bounds via a tiny smap; shard0 aliases the hoisted partition's
         # arrays directly instead of round-tripping the whole point set
-        # through a jit for a second device copy
+        # through a jit for a second device copy. The resident side is
+        # group-coarsened per device (wide tiles, no skip-self needed —
+        # see ring_knn_chunked)
         q_full = partition_sharded(pts, ids, mesh, bucket_size)
+        pgc = _effective_group(point_group, npad, bucket_size)
+        if pgc > 1:
+            q_full = smap(partial(coarsen_buckets, group=pgc),
+                          1, spec)(q_full)
         all_lo, all_hi = smap(gathered_bounds_fn, 1, (spec, spec))(pts)
         shard0 = (q_full.pts, q_full.ids, q_full.lower, q_full.upper)
         _qinit_q = smap(query_init_from_q, 4, (spec, spec))

@@ -686,7 +686,7 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
                      k: int, mesh, *, chunk_rows: int,
                      max_radius: float = jnp.inf, engine: str = "auto",
                      query_tile: int = 2048, point_tile: int = 2048,
-                     bucket_size: int = 0,
+                     bucket_size: int = 0, point_group: int = 1,
                      checkpoint_dir: str | None = None,
                      checkpoint_every: int = 1,
                      max_chunks: int | None = None,
@@ -763,6 +763,7 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
     my_pos = sorted(pts_b)
     n_my = len(my_pos)
     n_chunks = max(1, -(-npad_local // chunk_rows))
+    point_group = _effective_group(point_group, npad_local, bucket_size)
 
     def to_global(local, global_rows):
         if multi:
@@ -788,8 +789,17 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
     if query_from_q is not None:
         # tiled: hoisted partitions — ONE compiled sort pass shared by all
         # levels of the shard partition, another shared by every chunk's
-        # query partition (see partition_sharded)
+        # query partition (see partition_sharded). The RESIDENT side is
+        # group-coarsened (wide tiles); no warm start / skip-self here —
+        # chunk queries fold the whole resident shard including their own
+        # points exactly once, the normal self-inclusion. Coarsening runs
+        # per device inside shard_map: group boundaries never straddle
+        # shards (B_local is a power of two and the group is clamped to
+        # it), and the reshape stays communication-free by construction
         qf = partition_sharded(pts_glob, ids_glob, mesh, bucket_size)
+        if point_group > 1:
+            qf = smap(partial(coarsen_buckets, group=point_group),
+                      1, spec)(qf)
         shard0 = (qf.pts, qf.ids, qf.lower, qf.upper)
         _heapq = smap(query_from_q, 1, (spec, spec))
 
@@ -899,7 +909,8 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
             out += (_ring_stats(
                 engine, tiles_total, bucket_size,
                 chunks_run * num_shards * num_shards * chunk_rows
-                * npad_local, q_rows=chunk_rows, p_rows=npad_local),)
+                * npad_local, q_rows=chunk_rows, p_rows=npad_local,
+                point_group=point_group),)
         return out if len(out) > 1 else out[0]
     dists = out_d.reshape(-1)
     out = (dists,)
@@ -911,7 +922,8 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
         out += (_ring_stats(
             engine, tiles_total, bucket_size,
             chunks_run * num_shards * num_shards * chunk_rows * npad_local,
-            q_rows=chunk_rows, p_rows=npad_local),)
+            q_rows=chunk_rows, p_rows=npad_local,
+            point_group=point_group),)
     return out if len(out) > 1 else out[0]
 
 

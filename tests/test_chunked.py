@@ -75,3 +75,36 @@ def test_model_level_chunked_neighbors():
     rows = np.arange(200)
     np.testing.assert_allclose(np.sqrt(full[rows, idx[:, -1]]), d, rtol=1e-6)
     assert np.array_equal(idx[:, 0], rows)
+
+
+def test_chunked_point_group_matches_ungrouped():
+    """Chunked drivers coarsen only the resident side: results must be
+    byte-identical to the ungrouped chunked run on both pipelines."""
+    import numpy as np
+
+    from mpi_cuda_largescaleknn_tpu.core.config import KnnConfig
+    from mpi_cuda_largescaleknn_tpu.models.prepartitioned import (
+        PrePartitionedKNN,
+    )
+    from mpi_cuda_largescaleknn_tpu.models.unordered import UnorderedKNN
+    from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+    from tests.oracle import random_points
+
+    pts = random_points(600, seed=71)
+    base = UnorderedKNN(KnnConfig(k=5, engine="tiled", bucket_size=16,
+                                  query_chunk=40), mesh=get_mesh(4)).run(pts)
+    grouped = UnorderedKNN(KnnConfig(k=5, engine="tiled", bucket_size=16,
+                                     query_chunk=40, point_group=4),
+                           mesh=get_mesh(4)).run(pts)
+    np.testing.assert_array_equal(base, grouped)
+
+    srt = pts[np.argsort(pts[:, 0], kind="stable")]
+    parts = [srt[i * 150:(i + 1) * 150] for i in range(4)]
+    base_p = PrePartitionedKNN(KnnConfig(k=5, engine="tiled", bucket_size=16,
+                                         query_chunk=40),
+                               mesh=get_mesh(4)).run(parts)
+    grp_p = PrePartitionedKNN(KnnConfig(k=5, engine="tiled", bucket_size=16,
+                                        query_chunk=40, point_group=4),
+                              mesh=get_mesh(4)).run(parts)
+    for b, g in zip(base_p, grp_p):
+        np.testing.assert_array_equal(b, g)
