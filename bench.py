@@ -172,6 +172,17 @@ def _parse_lines(text: str) -> dict:
     return got
 
 
+def _log_probe(probe_log: list, attempt, got: dict) -> None:
+    """One probe_log entry per child attempt — single point of truth for
+    which child fields are preserved (stages attribute a timeout to its
+    phase)."""
+    probe_log.append({"attempt": attempt, "contact": got["contact"],
+                      "rc": got["rc"], "wall_s": got["wall_s"],
+                      "failsizes": got["failsizes"],
+                      "failengines": got["failengines"],
+                      "stages": got["stages"]})
+
+
 def _run_child(ladder, engine: str, env: dict, timeout_s: float,
                expect: str = "any") -> dict:
     """One probe+measure child; returns parsed stage lines + outcome."""
@@ -218,15 +229,7 @@ def main() -> int:
         cap = remaining if attempt == 1 else max(240.0, remaining * 0.55)
         got = _run_child(ladder_now, engine, dict(os.environ), cap,
                          expect="tpu")
-        probe_log.append({
-            "attempt": attempt + 1,
-            "contact": got["contact"],
-            "rc": got["rc"],
-            "wall_s": got["wall_s"],
-            "failsizes": got["failsizes"],
-            "failengines": got["failengines"],
-            "stages": got["stages"],  # attributes a timeout to its phase
-        })
+        _log_probe(probe_log, attempt + 1, got)
         if got["result"] is not None:
             result = got["result"]
             break
@@ -247,15 +250,33 @@ def main() -> int:
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("PALLAS_AXON_POOL_IPS", None)  # don't dial the tunnel
-        cpu_ladder = sorted({min(n, 50_000) for n in ladder}, reverse=True)
         remaining = max(60.0, BUDGET_S - (time.time() - t_start) - 10)
-        got = _run_child(cpu_ladder, engine, env, remaining)
-        probe_log.append({"attempt": "cpu-fallback", "contact": got["contact"],
-                          "rc": got["rc"], "wall_s": got["wall_s"],
-                          "failsizes": got["failsizes"],
-                          "failengines": got["failengines"],
-                          "stages": got["stages"]})
+        # budget-aware size cap: the FULL 1M config runs in ~100s on the
+        # CPU twin at the tuned geometry (compile 48s + 48s/run, round-5
+        # measurement), so a healthy remaining budget measures the real
+        # headline config instead of a 50K stand-in (the BENCH_r04
+        # misjudgment); a thin budget still guarantees a number
+        cpu_cap = (1_000_000 if remaining > 360
+                   else 250_000 if remaining > 180 else 50_000)
+        cpu_ladder = sorted({min(n, cpu_cap) for n in ladder}, reverse=True)
+        # hold back ~100s whenever a bigger-than-50K rung is attempted:
+        # the ladder only downshifts on RESOURCE errors, so if the big
+        # rung times out on a slow host, a funded small retry still
+        # produces a labeled number WITHIN the stated budget
+        big = cpu_ladder[0] > 50_000
+        cap_s = max(60.0, remaining - 100) if big else remaining
+        got = _run_child(cpu_ladder, engine, env, cap_s)
+        _log_probe(probe_log, "cpu-fallback", got)
         result = got["result"]
+        if result is None and big:
+            retry_s = BUDGET_S - (time.time() - t_start) - 5
+            if retry_s >= 45:
+                # never larger than what was asked for (BENCH_N can be
+                # below 50K), never beyond the budget
+                small = min(50_000, cpu_ladder[0])
+                got = _run_child([small], engine, env, retry_s)
+                _log_probe(probe_log, "cpu-fallback-small", got)
+                result = got["result"]
 
     if result is None:
         print(json.dumps({
