@@ -19,8 +19,8 @@ TPU_FLAGS = """
 TPU-side options (no reference analogue):
   --shards N        size of the 1-D device mesh (default: all devices)
   --engine E        tiled | pallas_tiled | bruteforce | tree | pallas | auto
-                    (default auto = tiled, the bucketed nearest-first engine;
-                    pallas_tiled is its fused-kernel form for real TPUs)
+                    (default auto = pallas_tiled, the fused nearest-first
+                    kernel, on real TPUs; the XLA twin `tiled` elsewhere)
   --query-tile N    queries per inner tile (flat engines; default 2048)
   --point-tile N    tree points per inner tile (flat engines; default 2048)
   --bucket-size N   points per spatial bucket (tiled engines; default

@@ -33,6 +33,44 @@ from mpi_cuda_largescaleknn_tpu.utils.math import cdiv
 _NEG_BIG = -(2**31) + 1  # int32 "minus infinity" for one-hot id extraction
 
 
+def default_fold_segments(lanes: int, k: int, cap: int = 16,
+                          env: str | None = None) -> int:
+    """Segment count for the multi-extract fold: one per 128-lane granule
+    up to ``cap`` at k>=32 (the fold handles uneven granule counts by
+    widening leading segments — no divisibility constraint), 1 below
+    (the per-segment [S,k] inserts outweigh saved scans at small k).
+    ``env`` names an environment variable that overrides when set
+    (clamped to the granule count)."""
+    granules = max(1, lanes // 128)
+    if env:
+        import os
+        req = int(os.environ.get(env, 0))
+        if req > 0:
+            return max(1, min(req, granules))
+    return max(1, min(granules, cap)) if k >= 32 else 1
+
+
+def _segment_bounds(t: int, segments: int) -> list[int]:
+    """Static slice boundaries for ``segments`` fold segments over ``t``
+    lanes, each a multiple of 128 when ``t`` is (leading segments absorb
+    the remainder granules); arbitrary (non-128) ``t`` falls back to
+    equal widths and requires divisibility."""
+    nseg = max(1, min(segments, t))
+    if t % 128 == 0:
+        g = t // 128
+        nseg = min(nseg, g)
+        base, extra = divmod(g, nseg)
+        widths = [128 * (base + (1 if i < extra else 0))
+                  for i in range(nseg)]
+    else:
+        assert t % nseg == 0, (t, nseg)
+        widths = [t // nseg] * nseg
+    bounds = [0]
+    for w in widths:
+        bounds.append(bounds[-1] + w)
+    return bounds
+
+
 def fold_tile_into_candidates(d2, ids_row, cand_d2, cand_idx,
                               with_passes: bool = False,
                               segments: int = 1):
@@ -46,22 +84,21 @@ def fold_tile_into_candidates(d2, ids_row, cand_d2, cand_idx,
     passes at segments=1, a warm-started row 1-3 — see ops/tiled.py
     warm_start_self).
 
-    ``segments`` (static, must divide T): each pass extracts the minimum
-    of EACH lane segment and inserts up to ``segments`` candidates per
-    row, so the pass count drops by up to that factor — the lever that
-    makes k=100 affordable (adoptions per chunk scale with k; tile scans
-    are the expensive part, the [S, k] inserts are cheap). The final
-    content is IDENTICAL to segments=1: inserting into a sorted row is
-    order-independent for the kept set, and segment order equals lane
-    order, so strict-< boundary ties resolve to the same (lowest-lane)
-    winner the global extract-min picks.
+    ``segments`` (static): each pass extracts the minimum of EACH lane
+    segment (128-granule-aligned; leading segments absorb any remainder)
+    and inserts up to ``segments`` candidates per row, so the pass count
+    drops by up to that factor — the lever that makes k=100 affordable
+    (adoptions per chunk scale with k; tile scans are the expensive part,
+    the [S, k] inserts are cheap). The final content is IDENTICAL to
+    segments=1: inserting into a sorted row is order-independent for the
+    kept set, and segment order equals lane order, so strict-< boundary
+    ties resolve to the same (lowest-lane) winner the global extract-min
+    picks.
     """
     s, t = d2.shape
     k = cand_d2.shape[1]
-    nseg = max(1, segments)
-    assert t % nseg == 0, (t, nseg)
-    w = t // nseg
-    lane_w = jax.lax.broadcasted_iota(jnp.int32, (s, w), 1)
+    bounds = _segment_bounds(t, segments)
+    nseg = len(bounds) - 1
     cols = jax.lax.broadcasted_iota(jnp.int32, (s, k), 1)
     ids_b = jnp.broadcast_to(ids_row, (s, t))
 
@@ -93,8 +130,11 @@ def fold_tile_into_candidates(d2, ids_row, cand_d2, cand_idx,
         _, d2, cd2, cidx, npass = carry
         blocks = []
         for sg in range(nseg):                        # static unroll
-            blk = jax.lax.slice_in_dim(d2, sg * w, (sg + 1) * w, axis=1)
-            idb = jax.lax.slice_in_dim(ids_b, sg * w, (sg + 1) * w, axis=1)
+            lo, hi = bounds[sg], bounds[sg + 1]
+            w = hi - lo
+            blk = jax.lax.slice_in_dim(d2, lo, hi, axis=1)
+            idb = jax.lax.slice_in_dim(ids_b, lo, hi, axis=1)
+            lane_w = jax.lax.broadcasted_iota(jnp.int32, (s, w), 1)
             m = jnp.min(blk, axis=1)                  # [S]
             improved = m[:, None] < kth(cd2)          # [S, 1]
             # first lane holding the segment minimum
@@ -118,7 +158,7 @@ def fold_tile_into_candidates(d2, ids_row, cand_d2, cand_idx,
 
 
 def _kernel(q_ref, pt_ref, pid_ref, in_d2_ref, in_idx_ref,
-            out_d2_ref, out_idx_ref):
+            out_d2_ref, out_idx_ref, *, fold_segments):
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -133,7 +173,8 @@ def _kernel(q_ref, pt_ref, pid_ref, in_d2_ref, in_idx_ref,
     d2 = (dx * dx + dy * dy) + dz * dz
 
     cd2, cidx = fold_tile_into_candidates(d2, pid_ref[:], out_d2_ref[:],
-                                          out_idx_ref[:])
+                                          out_idx_ref[:],
+                                          segments=fold_segments)
     out_d2_ref[:] = cd2
     out_idx_ref[:] = cidx
 
@@ -145,8 +186,11 @@ def _run(q_pad, p_t, ids_2d, in_d2, in_idx, *, query_tile, point_tile,
     nq, k = in_d2.shape
     npts = p_t.shape[1]
     grid = (nq // query_tile, npts // point_tile)
+    # multi-extract fold at large k; LSK_FOLD_SEGS overrides here exactly
+    # as in the traversal kernel (docs/TUNING.md)
+    segs = default_fold_segments(point_tile, k, env="LSK_FOLD_SEGS")
     out_d2, out_idx = pl.pallas_call(
-        _kernel,
+        functools.partial(_kernel, fold_segments=segs),
         grid=grid,
         in_specs=[
             pl.BlockSpec((query_tile, 3), lambda i, j: (i, 0),

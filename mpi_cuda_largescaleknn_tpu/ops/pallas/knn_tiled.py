@@ -39,6 +39,7 @@ from jax import lax
 
 from mpi_cuda_largescaleknn_tpu.core.types import PAD_SENTINEL, CandidateState
 from mpi_cuda_largescaleknn_tpu.ops.pallas.knn_bf import (
+    default_fold_segments,
     fold_tile_into_candidates,
 )
 from mpi_cuda_largescaleknn_tpu.ops.partition import (
@@ -342,15 +343,7 @@ def knn_update_tiled_pallas(state: CandidateState, q: BucketedPoints,
     # segment per pass (fold_tile_into_candidates). LSK_FOLD_SEGS
     # overrides (trace-time, like LSK_CHUNK_LANES)
     lanes_total = visit_batch * p_t.shape[2]
-    fold_segs = int(os.environ.get("LSK_FOLD_SEGS", 0))
-    if fold_segs <= 0:
-        fold_segs = (max(1, min(lanes_total // 128, 16))
-                     if k >= 32 else 1)
-    # sanitize the env override at the read site: clamp to the lane count
-    # and round down to a divisor (a bad sweep value must tune, not crash)
-    fold_segs = max(1, min(fold_segs, lanes_total // 128))
-    while lanes_total % fold_segs:
-        fold_segs -= 1
+    fold_segs = default_fold_segments(lanes_total, k, env="LSK_FOLD_SEGS")
     ss = jnp.asarray(0 if skip_self is None else skip_self,
                      jnp.int32).reshape(1, 1, 1)
     out_d2, out_idx, visits = _run(order[:, None, :], sorted_d2[:, None, :],

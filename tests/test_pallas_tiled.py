@@ -162,3 +162,17 @@ def test_fold_segments_bitidentical():
         np.testing.assert_array_equal(np.asarray(g_d2), np.asarray(base_d2))
         np.testing.assert_array_equal(np.asarray(g_idx), np.asarray(base_idx))
         assert int(g_p) < int(base_p), (nseg, int(g_p), int(base_p))
+
+    # uneven granule count (17 x 128 lanes, 16 segments: the leading
+    # segment absorbs the remainder granule) stays bit-identical
+    t2 = 2176
+    d2b = rng.random((s, t2)).astype(np.float32)
+    ids2 = np.arange(t2, dtype=np.int32)[None, :]
+    ref_d2, ref_idx = fold_tile_into_candidates(
+        jnp.asarray(d2b), jnp.asarray(ids2), jnp.asarray(cd2),
+        jnp.asarray(cidx), segments=1)
+    got_d2, got_idx = fold_tile_into_candidates(
+        jnp.asarray(d2b), jnp.asarray(ids2), jnp.asarray(cd2),
+        jnp.asarray(cidx), segments=16)
+    np.testing.assert_array_equal(np.asarray(got_d2), np.asarray(ref_d2))
+    np.testing.assert_array_equal(np.asarray(got_idx), np.asarray(ref_idx))
