@@ -24,7 +24,8 @@ class KnnConfig:
                                      # the runtime owns device binding)
 
     # --- TPU-side knobs ----------------------------------------------------
-    engine: str = "auto"             # "auto" (= tiled) | "tiled" | "pallas_tiled"
+    engine: str = "auto"             # "auto" (pallas_tiled on TPU, tiled
+                                     # elsewhere) | "tiled" | "pallas_tiled"
                                      # | "bruteforce" | "tree" | "pallas"
     query_tile: int = 2048           # queries processed per inner tile
     point_tile: int = 2048           # tree points per inner tile

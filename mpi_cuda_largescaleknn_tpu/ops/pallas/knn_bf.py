@@ -44,7 +44,10 @@ def default_fold_segments(lanes: int, k: int, cap: int = 16,
     granules = max(1, lanes // 128)
     if env:
         import os
-        req = int(os.environ.get(env, 0))
+        try:
+            req = int(os.environ.get(env, "") or 0)
+        except ValueError:
+            req = 0                 # a bad sweep value must tune, not crash
         if req > 0:
             return max(1, min(req, granules))
     return max(1, min(granules, cap)) if k >= 32 else 1
@@ -180,17 +183,14 @@ def _kernel(q_ref, pt_ref, pid_ref, in_d2_ref, in_idx_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("query_tile", "point_tile",
-                                             "interpret"))
+                                             "interpret", "fold_segments"))
 def _run(q_pad, p_t, ids_2d, in_d2, in_idx, *, query_tile, point_tile,
-         interpret):
+         interpret, fold_segments):
     nq, k = in_d2.shape
     npts = p_t.shape[1]
     grid = (nq // query_tile, npts // point_tile)
-    # multi-extract fold at large k; LSK_FOLD_SEGS overrides here exactly
-    # as in the traversal kernel (docs/TUNING.md)
-    segs = default_fold_segments(point_tile, k, env="LSK_FOLD_SEGS")
     out_d2, out_idx = pl.pallas_call(
-        functools.partial(_kernel, fold_segments=segs),
+        functools.partial(_kernel, fold_segments=fold_segments),
         grid=grid,
         in_specs=[
             pl.BlockSpec((query_tile, 3), lambda i, j: (i, 0),
@@ -268,6 +268,11 @@ def knn_update_pallas(state: CandidateState, queries: jnp.ndarray,
     in_d2 = _pad_rows(state.dist2, nq_pad, jnp.inf)
     in_idx = _pad_rows(state.idx, nq_pad, -1)
 
+    # computed OUTSIDE the jit and passed static, so an env change
+    # retraces instead of silently reusing the old segment count (the
+    # traversal kernel does the same — docs/TUNING.md)
+    segs = default_fold_segments(pt, k, env="LSK_FOLD_SEGS")
     out_d2, out_idx = _run(q_pad, p_pad.T, ids_2d, in_d2, in_idx,
-                           query_tile=qt, point_tile=pt, interpret=interpret)
+                           query_tile=qt, point_tile=pt, interpret=interpret,
+                           fold_segments=segs)
     return CandidateState(out_d2[:num_q], out_idx[:num_q])

@@ -152,12 +152,16 @@ def _kernel(order_ref, boxd2_ref,            # SMEM: [1, 1, Bp] i32 / f32
                   for si in s_idxs]           # static unroll, SMEM scalars
         # the last chunk may be padded with duplicates of bucket num_pb-1:
         # folding a point twice would corrupt the candidate list, so those
-        # lanes are masked unconditionally (strict-< never adopts +inf)
+        # lanes are masked unconditionally (strict-< never adopts +inf).
+        # The per-bucket mask rides as an f32 penalty row (+inf on dropped
+        # buckets) rather than a bool vector: f32 full/concat/add are the
+        # op classes this kernel already Mosaic-compiled in round 4;
+        # broadcast bool vectors are not
         n_valid = (jnp.minimum(num_pb - c * v_b, v_b)) * t_p
-        keep_lane = jnp.concatenate(
-            [jnp.full((1, t_p), kv, jnp.bool_) for kv in keep_v], axis=1)
-        keep = keep_lane & (lane < n_valid)
-        d2 = jnp.where(keep, d2, jnp.inf)
+        penalty = jnp.concatenate(
+            [jnp.full((1, t_p), jnp.where(kv, 0.0, jnp.inf), jnp.float32)
+             for kv in keep_v], axis=1)
+        d2 = jnp.where(lane < n_valid, d2 + penalty, jnp.inf)
         cd2, cidx, dp = fold_tile_into_candidates(d2, ids, cd2, cidx,
                                                   with_passes=True,
                                                   segments=fold_segments)
