@@ -30,8 +30,6 @@ from jax.experimental.pallas import tpu as pltpu
 from mpi_cuda_largescaleknn_tpu.core.types import PAD_SENTINEL, CandidateState
 from mpi_cuda_largescaleknn_tpu.utils.math import cdiv
 
-_NEG_BIG = -(2**31) + 1  # int32 "minus infinity" for one-hot id extraction
-
 
 def default_fold_segments(lanes: int, k: int, cap: int = 16,
                           env: str | None = None) -> int:
@@ -74,18 +72,28 @@ def _segment_bounds(t: int, segments: int) -> list[int]:
     return bounds
 
 
-def fold_tile_into_candidates(d2, ids_row, cand_d2, cand_idx,
+def fold_tile_into_candidates(d2, lane_base, cand_d2, cand_idx,
                               with_passes: bool = False,
                               segments: int = 1):
     """Fold a distance tile ``f32[S, T]`` into sorted candidate rows.
 
-    ``ids_row``: i32[1, T] point ids for the tile's lanes. Returns updated
-    (cand_d2, cand_idx), both [S, k]. Pure jnp — usable inside any kernel (or
-    interpreted for tests). With ``with_passes`` additionally returns the
-    i32 number of tile-scan passes the loop ran — the k-scaling cost
-    center (each pass sweeps the whole tile; a cold row pays up to ~k
-    passes at segments=1, a warm-started row 1-3 — see ops/tiled.py
-    warm_start_self).
+    ``lane_base``: i32 scalar (traced or python int) — the global lane
+    position of the tile's lane 0. Adopted entries are stored as ENCODED
+    LANE POSITIONS ``-2 - (lane_base + lane)`` (distinct from real ids
+    ``>= 0`` and the ``-1`` init sentinel, so they coexist with entries
+    from prior rounds / warm starts in the same row); the caller maps
+    positions back to point ids outside the kernel (`decode` helpers in
+    the wrappers). Point ids never enter the kernel at all: an id row
+    would have to be broadcast ``[1, T] -> [S, T]`` in i32, which Mosaic's
+    TPU lowering crashes on at some geometries (v5e, S=64), while the lane
+    index falls out of the extract-min bookkeeping for free.
+
+    Returns updated (cand_d2, cand_idx), both [S, k]. Pure jnp — usable
+    inside any kernel (or interpreted for tests). With ``with_passes``
+    additionally returns the i32 number of tile-scan passes the loop ran —
+    the k-scaling cost center (each pass sweeps the whole tile; a cold row
+    pays up to ~k passes at segments=1, a warm-started row 1-3 — see
+    ops/tiled.py warm_start_self).
 
     ``segments`` (static): each pass extracts the minimum of EACH lane
     segment (128-granule-aligned; leading segments absorb any remainder)
@@ -103,7 +111,6 @@ def fold_tile_into_candidates(d2, ids_row, cand_d2, cand_idx,
     bounds = _segment_bounds(t, segments)
     nseg = len(bounds) - 1
     cols = jax.lax.broadcasted_iota(jnp.int32, (s, k), 1)
-    ids_b = jnp.broadcast_to(ids_row, (s, t))
 
     def kth(cd2):
         # static slice, NOT cd2[:, -1]: integer indexing lowers to
@@ -136,7 +143,6 @@ def fold_tile_into_candidates(d2, ids_row, cand_d2, cand_idx,
             lo, hi = bounds[sg], bounds[sg + 1]
             w = hi - lo
             blk = jax.lax.slice_in_dim(d2, lo, hi, axis=1)
-            idb = jax.lax.slice_in_dim(ids_b, lo, hi, axis=1)
             lane_w = jax.lax.broadcasted_iota(jnp.int32, (s, w), 1)
             m = jnp.min(blk, axis=1)                  # [S]
             improved = m[:, None] < kth(cd2)          # [S, 1]
@@ -144,7 +150,8 @@ def fold_tile_into_candidates(d2, ids_row, cand_d2, cand_idx,
             is_min = blk == m[:, None]
             ml = jnp.min(jnp.where(is_min, lane_w, w), axis=1)
             sel = is_min & (lane_w == ml[:, None])
-            mid = jnp.max(jnp.where(sel, idb, _NEG_BIG), axis=1)
+            # encoded global lane position of the extracted lane
+            mid = -2 - (lane_base + lo + ml)
             # consume the extracted lane
             blocks.append(jnp.where(sel & improved, jnp.inf, blk))
             cd2, cidx = insert(cd2, cidx, m, mid, improved)
@@ -160,8 +167,8 @@ def fold_tile_into_candidates(d2, ids_row, cand_d2, cand_idx,
     return cand_d2, cand_idx
 
 
-def _kernel(q_ref, pt_ref, pid_ref, in_d2_ref, in_idx_ref,
-            out_d2_ref, out_idx_ref, *, fold_segments):
+def _kernel(q_ref, pt_ref, in_d2_ref, in_idx_ref,
+            out_d2_ref, out_idx_ref, *, point_tile, fold_segments):
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -175,7 +182,7 @@ def _kernel(q_ref, pt_ref, pid_ref, in_d2_ref, in_idx_ref,
     dz = q[:, 2:3] - pt_ref[2:3, :]
     d2 = (dx * dx + dy * dy) + dz * dz
 
-    cd2, cidx = fold_tile_into_candidates(d2, pid_ref[:], out_d2_ref[:],
+    cd2, cidx = fold_tile_into_candidates(d2, j * point_tile, out_d2_ref[:],
                                           out_idx_ref[:],
                                           segments=fold_segments)
     out_d2_ref[:] = cd2
@@ -184,20 +191,19 @@ def _kernel(q_ref, pt_ref, pid_ref, in_d2_ref, in_idx_ref,
 
 @functools.partial(jax.jit, static_argnames=("query_tile", "point_tile",
                                              "interpret", "fold_segments"))
-def _run(q_pad, p_t, ids_2d, in_d2, in_idx, *, query_tile, point_tile,
+def _run(q_pad, p_t, in_d2, in_idx, *, query_tile, point_tile,
          interpret, fold_segments):
     nq, k = in_d2.shape
     npts = p_t.shape[1]
     grid = (nq // query_tile, npts // point_tile)
     out_d2, out_idx = pl.pallas_call(
-        functools.partial(_kernel, fold_segments=fold_segments),
+        functools.partial(_kernel, point_tile=point_tile,
+                          fold_segments=fold_segments),
         grid=grid,
         in_specs=[
             pl.BlockSpec((query_tile, 3), lambda i, j: (i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((3, point_tile), lambda i, j: (0, j),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, point_tile), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((query_tile, k), lambda i, j: (i, 0),
                          memory_space=pltpu.VMEM),
@@ -223,8 +229,17 @@ def _run(q_pad, p_t, ids_2d, in_d2, in_idx, *, query_tile, point_tile,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
-    )(q_pad, p_t, ids_2d, in_d2, in_idx)
+    )(q_pad, p_t, in_d2, in_idx)
     return out_d2, out_idx
+
+
+def decode_positions(idx, ids_flat):
+    """Map encoded lane positions (``<= -2``, fold_tile_into_candidates)
+    back to point ids via the padded id table; real ids and the ``-1``
+    sentinel pass through untouched. One XLA gather — runs outside the
+    kernel."""
+    pos = jnp.clip(-2 - idx, 0, ids_flat.shape[0] - 1)
+    return jnp.where(idx <= -2, jnp.take(ids_flat, pos, axis=0), idx)
 
 
 def _pad_rows(arr, target, fill):
@@ -243,6 +258,12 @@ def knn_update_pallas(state: CandidateState, queries: jnp.ndarray,
 
     ``interpret=None`` auto-selects interpreter mode off-TPU so the same tests
     run on the CPU fixture.
+
+    Precondition: ``point_ids`` and ``state.idx`` entries must be ``>= -1``
+    (true of everything this package produces — real ids are ``>= 0``, the
+    pad sentinel is ``-1``). Values ``<= -2`` would alias the fold's
+    lane-position encoding and decode to unrelated ids
+    (fold_tile_into_candidates).
     """
     if interpret is None:
         from mpi_cuda_largescaleknn_tpu.ops.pallas import is_tpu_backend
@@ -264,7 +285,7 @@ def knn_update_pallas(state: CandidateState, queries: jnp.ndarray,
 
     q_pad = _pad_rows(jnp.asarray(queries, jnp.float32), nq_pad, PAD_SENTINEL)
     p_pad = _pad_rows(jnp.asarray(points, jnp.float32), np_pad, PAD_SENTINEL)
-    ids_2d = _pad_rows(jnp.asarray(point_ids, jnp.int32), np_pad, -1)[None, :]
+    ids_flat = _pad_rows(jnp.asarray(point_ids, jnp.int32), np_pad, -1)
     in_d2 = _pad_rows(state.dist2, nq_pad, jnp.inf)
     in_idx = _pad_rows(state.idx, nq_pad, -1)
 
@@ -272,7 +293,10 @@ def knn_update_pallas(state: CandidateState, queries: jnp.ndarray,
     # retraces instead of silently reusing the old segment count (the
     # traversal kernel does the same — docs/TUNING.md)
     segs = default_fold_segments(pt, k, env="LSK_FOLD_SEGS")
-    out_d2, out_idx = _run(q_pad, p_pad.T, ids_2d, in_d2, in_idx,
+    out_d2, out_idx = _run(q_pad, p_pad.T, in_d2, in_idx,
                            query_tile=qt, point_tile=pt, interpret=interpret,
                            fold_segments=segs)
+    # entries the kernel adopted are encoded lane positions into the padded
+    # point array; map them to ids here (ids never enter the kernel)
+    out_idx = decode_positions(out_idx, ids_flat)
     return CandidateState(out_d2[:num_q], out_idx[:num_q])

@@ -52,12 +52,12 @@ def _kernel(order_ref, boxd2_ref,            # SMEM: [1, 1, Bp] i32 / f32
             sskip_ref,                       # SMEM: [1, 1, 1] i32 skip-self
             q_ref, qid_ref,                  # VMEM: [1, S, 3] / [1, S, 1]
             in_d2_ref, in_idx_ref,           # VMEM: [S, k]
-            p_hbm, pid_hbm,                  # ANY (HBM): [Bp, 4, T] / [Bp, 1, T]
+            p_hbm,                           # ANY (HBM): [Bp, 4, T]
             out_d2_ref, out_idx_ref,         # VMEM: [S, k]
             vis_ref,                         # SMEM: [1,1,2] i32 [visits,
                                              #        fold passes]
-            p_buf, id_buf, sem_p, sem_i,     # scratch: [2,4,V*T], [2,1,V*T],
-            *, visit_batch, self_group,      #          (2,V), (2,V)
+            p_buf, sem_p,                    # scratch: [2,4,V*T], (2,V)
+            *, visit_batch, self_group,
             fold_segments):
     num_pb = p_hbm.shape[0]
     t_p = p_hbm.shape[2]
@@ -76,17 +76,16 @@ def _kernel(order_ref, boxd2_ref,            # SMEM: [1, 1, Bp] i32 / f32
     # extract-min passes over V buckets instead of paying them per bucket
     # (the per-visit form measured 85M pair-evals/s on a v5e: pure overhead).
     def chunk_copies(slot, c):
-        # one descriptor per (bucket, array); start and wait must describe
-        # the SAME copies, so both go through this single generator
+        # one descriptor per bucket; start and wait must describe the SAME
+        # copies, so both go through this single generator. Point ids do
+        # NOT ride along: the fold records lane positions and the wrapper
+        # maps them to ids through the visit order after the kernel
         for v in range(v_b):                 # static unroll
             s_idx = jnp.minimum(c * v_b + v, num_pb - 1)
             visit = order_ref[0, 0, s_idx]
             yield pltpu.make_async_copy(
                 p_hbm.at[visit], p_buf.at[slot, :, pl.ds(v * t_p, t_p)],
                 sem_p.at[slot, v])
-            yield pltpu.make_async_copy(
-                pid_hbm.at[visit], id_buf.at[slot, :, pl.ds(v * t_p, t_p)],
-                sem_i.at[slot, v])
 
     def start_chunk(slot, c):
         for cp in chunk_copies(slot, c):
@@ -129,7 +128,6 @@ def _kernel(order_ref, boxd2_ref,            # SMEM: [1, 1, Bp] i32 / f32
 
         wait_chunk(slot, c)
         p = p_buf[slot]                       # [4, V*T]; row 3 is tiling pad
-        ids = id_buf[slot]                    # [1, V*T]
         dx = q[:, 0:1] - p[0:1, :]
         dy = q[:, 1:2] - p[1:2, :]
         dz = q[:, 2:3] - p[2:3, :]
@@ -162,7 +160,10 @@ def _kernel(order_ref, boxd2_ref,            # SMEM: [1, 1, Bp] i32 / f32
             [jnp.full((1, t_p), jnp.where(kv, 0.0, jnp.inf), jnp.float32)
              for kv in keep_v], axis=1)
         d2 = jnp.where(lane < n_valid, d2 + penalty, jnp.inf)
-        cd2, cidx, dp = fold_tile_into_candidates(d2, ids, cd2, cidx,
+        # lane positions are global over the visit schedule: chunk c's lane
+        # 0 sits at visit slot c*V, so pos // T = visit slot, pos % T = lane
+        cd2, cidx, dp = fold_tile_into_candidates(d2, c * (v_b * t_p),
+                                                  cd2, cidx,
                                                   with_passes=True,
                                                   segments=fold_segments)
         nvis = nvis + sum((kv & (c * v_b + v < num_pb)).astype(jnp.int32)
@@ -196,15 +197,15 @@ def _vmem_limit(s_q: int, t_p: int, visit_batch: int, k: int) -> int:
     """Scoped-VMEM ceiling for the kernel's actual footprint.
 
     Dominant terms: the [S, V*T] f32 distance tile (plus its jnp.where
-    twins — budget 3x), the double-buffered [2, 4, V*T] f32 + [2, 1, V*T]
-    i32 chunk scratch, and the [S, k] x4 candidate rows. Everything else
-    (query block, SMEM schedules) is noise. Keep the 16MB default whenever
-    it suffices; otherwise pad the computed need by 2x for Mosaic's
+    twins — budget 3x), the double-buffered [2, 4, V*T] f32 chunk scratch,
+    and the [S, k] x4 candidate rows. Everything else (query block, SMEM
+    schedules) is noise. Keep the 16MB default whenever it suffices;
+    otherwise pad the computed need by 2x for Mosaic's
     spills/temporaries, capped at 100MB (v5e physical VMEM is 128MiB).
     """
     lanes = visit_batch * t_p
     need = (3 * s_q * lanes * 4        # distance tile + masked copies
-            + 2 * 5 * lanes * 4        # double-buffered chunk scratch
+            + 2 * 4 * lanes * 4        # double-buffered chunk scratch
             + 4 * s_q * k * 4)         # candidate rows in/out
     default = 16 * 1024 * 1024
     if need <= default // 2:           # 2x headroom inside the default
@@ -214,7 +215,7 @@ def _vmem_limit(s_q: int, t_p: int, visit_batch: int, k: int) -> int:
 
 @functools.partial(jax.jit, static_argnames=("interpret", "visit_batch",
                                              "self_group", "fold_segments"))
-def _run(order, boxd2, sskip, q_pts, q_ids, in_d2, in_idx, p_t, pid_t, *,
+def _run(order, boxd2, sskip, q_pts, q_ids, in_d2, in_idx, p_t, *,
          interpret, visit_batch, self_group, fold_segments):
     num_qb, s_q, _one = q_ids.shape
     num_pb, _, t_p = p_t.shape
@@ -244,7 +245,6 @@ def _run(order, boxd2, sskip, q_pts, q_ids, in_d2, in_idx, p_t, pid_t, *,
             pl.BlockSpec((s_q, k), lambda b: (b, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=(
             pl.BlockSpec((s_q, k), lambda b: (b, 0),
@@ -269,8 +269,6 @@ def _run(order, boxd2, sskip, q_pts, q_ids, in_d2, in_idx, p_t, pid_t, *,
         ),
         scratch_shapes=[
             pltpu.VMEM((2, p_t.shape[1], visit_batch * t_p), jnp.float32),
-            pltpu.VMEM((2, 1, visit_batch * t_p), jnp.int32),
-            pltpu.SemaphoreType.DMA((2, visit_batch)),
             pltpu.SemaphoreType.DMA((2, visit_batch)),
         ],
         compiler_params=pltpu.CompilerParams(
@@ -282,7 +280,7 @@ def _run(order, boxd2, sskip, q_pts, q_ids, in_d2, in_idx, p_t, pid_t, *,
             # small shapes and non-v5e parts keep the default guardrail
             vmem_limit_bytes=_vmem_limit(s_q, t_p, visit_batch, k)),
         interpret=interpret,
-    )(order, boxd2, sskip, q_pts, q_ids, in_d2, in_idx, p_t, pid_t)
+    )(order, boxd2, sskip, q_pts, q_ids, in_d2, in_idx, p_t)
     return out_d2, out_idx, visits
 
 
@@ -305,7 +303,13 @@ def knn_update_tiled_pallas(state: CandidateState, q: BucketedPoints,
     (tools/tpu_probe);
     ``skip_self``/``self_group`` as in the twin: nonzero masks point bucket
     b // self_group out of query bucket b's traversal for warm-started
-    self-joins)."""
+    self-joins).
+
+    Precondition: ``p.ids`` and ``state.idx`` entries must be ``>= -1``
+    (true of everything this package produces — real ids are ``>= 0``, the
+    pad sentinel is ``-1``). Values ``<= -2`` would alias the fold's
+    lane-position encoding and decode to unrelated ids
+    (fold_tile_into_candidates)."""
     if interpret is None:
         from mpi_cuda_largescaleknn_tpu.ops.pallas import is_tpu_backend
         interpret = not is_tpu_backend()
@@ -325,10 +329,12 @@ def knn_update_tiled_pallas(state: CandidateState, q: BucketedPoints,
     lane_pad = (-p3.shape[2]) % 128
     p_t = jnp.pad(p3, ((0, 0), (0, 1), (0, lane_pad)),
                   constant_values=PAD_SENTINEL)
+    # id table for the post-kernel position decode (ids never enter the
+    # kernel — see fold_tile_into_candidates); pad lanes decode to -1 but
+    # are never adopted anyway (their coords are PAD_SENTINEL -> +inf d2)
     pid = p.ids
     if lane_pad:
         pid = jnp.pad(pid, ((0, 0), (0, lane_pad)), constant_values=-1)
-    pid_t = pid[:, None, :]                   # [Bp, 1, T]
 
     assert state.dist2.shape == (num_qb * s_q, k), (state.dist2.shape,
                                                     (num_qb, s_q, k))
@@ -352,11 +358,21 @@ def knn_update_tiled_pallas(state: CandidateState, q: BucketedPoints,
                      jnp.int32).reshape(1, 1, 1)
     out_d2, out_idx, visits = _run(order[:, None, :], sorted_d2[:, None, :],
                                    ss, q.pts, q.ids[:, :, None],
-                                   state.dist2, state.idx, p_t, pid_t,
+                                   state.dist2, state.idx, p_t,
                                    interpret=interpret,
                                    visit_batch=visit_batch,
                                    self_group=self_group,
                                    fold_segments=fold_segs)
+    # decode encoded lane positions (<= -2) through the per-query-bucket
+    # visit order: pos // T names the visit slot, pos % T the lane within
+    # the visited bucket. Entries carried in from prior rounds / warm
+    # starts are real ids (>= -1) and pass through untouched.
+    t_pad = p_t.shape[2]
+    enc = out_idx.reshape(num_qb, s_q * k)
+    pos = jnp.clip(-2 - enc, 0, p_t.shape[0] * t_pad - 1)
+    bucket = jnp.take_along_axis(order, pos // t_pad, axis=1)
+    ids_new = jnp.take(pid.reshape(-1), bucket * t_pad + pos % t_pad, axis=0)
+    out_idx = jnp.where(enc <= -2, ids_new, enc).reshape(out_idx.shape)
     out = CandidateState(out_d2, out_idx)
     if with_stats == "full":
         return (out, jnp.sum(visits[:, :, 0]).astype(jnp.int32),

@@ -98,3 +98,32 @@ def test_neighbor_ids_are_correct():
     rows = np.arange(200)[:, None]
     np.testing.assert_allclose(d2[rows, got_idx], got_d, rtol=1e-5, atol=1e-7)
     del want_idx
+
+
+def test_neighbor_ids_decode_exactly():
+    """Flat-kernel decode of encoded lane positions: every stored (d2, id)
+    pair recomputes exactly, including entries adopted in a SECOND call
+    (cross-round continuation, where positions from round 2 coexist with
+    ids decoded after round 1)."""
+    pts = random_points(300, seed=13)
+    k = 6
+    q = pts[:96]
+    st = knn_update_pallas(init_candidates(96, k), q, pts[:150],
+                           point_ids=np.arange(150, dtype=np.int32),
+                           query_tile=32, point_tile=128)
+    st = knn_update_pallas(st, q, pts[150:],
+                           point_ids=np.arange(150, 300, dtype=np.int32),
+                           query_tile=32, point_tile=128)
+    d2 = np.asarray(st.dist2)
+    idx = np.asarray(st.idx)
+    for row in range(96):
+        finite = np.isfinite(d2[row])
+        ids_row = idx[row][finite]
+        assert np.all(ids_row >= 0), (row, idx[row])
+        assert len(np.unique(ids_row)) == len(ids_row), (row, ids_row)
+        recomputed = ((q[row] - pts[ids_row]) ** 2).sum(axis=1)
+        # tight tolerance, not bit-equality: the kernel's FMA-contracted
+        # f32 sum can differ from numpy by 1 ulp; a WRONG id would be off
+        # by orders of magnitude on random points
+        np.testing.assert_allclose(recomputed.astype(np.float32),
+                                   d2[row][finite], rtol=1e-5, atol=1e-9)

@@ -149,15 +149,14 @@ def test_fold_segments_bitidentical():
     s, t, k = 16, 512, 100
     d2 = rng.random((s, t)).astype(np.float32)
     d2[:, 128:256] = d2[:, :128]          # exact value ties across segments
-    ids = np.arange(t, dtype=np.int32)[None, :]
     cd2 = np.full((s, k), np.inf, np.float32)
     cidx = np.full((s, k), -1, np.int32)
     base_d2, base_idx, base_p = fold_tile_into_candidates(
-        jnp.asarray(d2), jnp.asarray(ids), jnp.asarray(cd2),
+        jnp.asarray(d2), 0, jnp.asarray(cd2),
         jnp.asarray(cidx), with_passes=True, segments=1)
     for nseg in (2, 4, 16):
         g_d2, g_idx, g_p = fold_tile_into_candidates(
-            jnp.asarray(d2), jnp.asarray(ids), jnp.asarray(cd2),
+            jnp.asarray(d2), 0, jnp.asarray(cd2),
             jnp.asarray(cidx), with_passes=True, segments=nseg)
         np.testing.assert_array_equal(np.asarray(g_d2), np.asarray(base_d2))
         np.testing.assert_array_equal(np.asarray(g_idx), np.asarray(base_idx))
@@ -167,12 +166,45 @@ def test_fold_segments_bitidentical():
     # segment absorbs the remainder granule) stays bit-identical
     t2 = 2176
     d2b = rng.random((s, t2)).astype(np.float32)
-    ids2 = np.arange(t2, dtype=np.int32)[None, :]
     ref_d2, ref_idx = fold_tile_into_candidates(
-        jnp.asarray(d2b), jnp.asarray(ids2), jnp.asarray(cd2),
+        jnp.asarray(d2b), 0, jnp.asarray(cd2),
         jnp.asarray(cidx), segments=1)
     got_d2, got_idx = fold_tile_into_candidates(
-        jnp.asarray(d2b), jnp.asarray(ids2), jnp.asarray(cd2),
+        jnp.asarray(d2b), 0, jnp.asarray(cd2),
         jnp.asarray(cidx), segments=16)
     np.testing.assert_array_equal(np.asarray(got_d2), np.asarray(ref_d2))
     np.testing.assert_array_equal(np.asarray(got_idx), np.asarray(ref_idx))
+
+
+def test_neighbor_ids_decode_exactly():
+    """The kernel stores encoded lane positions and the wrapper decodes
+    them to point ids (fold_tile_into_candidates); every stored (d2, id)
+    pair must recompute exactly, ids must be unique per row, and the
+    decode must survive the mixed case where warm-started rows already
+    hold real ids (the fused driver's path: warm_start_self + skip_self +
+    a coarsened point side)."""
+    from mpi_cuda_largescaleknn_tpu.ops.partition import coarsen_buckets
+    from mpi_cuda_largescaleknn_tpu.ops.tiled import warm_start_self
+
+    pts = random_points(600, seed=33)
+    k = 7
+    q = partition_points(jnp.asarray(pts), bucket_size=16)
+    pc = coarsen_buckets(q, 4)
+    warm = warm_start_self(pc, k)
+    state = knn_update_tiled_pallas(warm, q, pc, skip_self=jnp.int32(1),
+                                    self_group=4)
+    d2 = np.asarray(state.dist2)
+    idx = np.asarray(state.idx)
+    qpts = np.asarray(q.pts).reshape(-1, 3)
+    qids = np.asarray(q.ids).reshape(-1)
+    for row in np.nonzero(qids >= 0)[0]:
+        finite = np.isfinite(d2[row])
+        ids_row = idx[row][finite]
+        assert np.all(ids_row >= 0), (row, idx[row])
+        assert len(np.unique(ids_row)) == len(ids_row), (row, ids_row)
+        recomputed = ((qpts[row] - pts[ids_row]) ** 2).sum(axis=1)
+        # tight tolerance, not bit-equality: the kernel's FMA-contracted
+        # f32 sum can differ from numpy by 1 ulp; a WRONG id would be off
+        # by orders of magnitude on random points
+        np.testing.assert_allclose(recomputed.astype(np.float32),
+                                   d2[row][finite], rtol=1e-5, atol=1e-9)

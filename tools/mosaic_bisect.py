@@ -41,6 +41,20 @@ def case_min(d2):
     return jnp.broadcast_to(m[:, None], (S, K))
 
 
+def case_i32_row_bcast_s64(d2):
+    # minimal repro of the round-5 probe crash (tpu_compile_helper exit 1
+    # on `vector.broadcast vector<1x128xi32> -> vector<64x128xi32>`): an
+    # i32 [1, 128] row broadcast to 64 sublanes and sliced. The production
+    # kernels no longer contain this op class (fold_tile_into_candidates
+    # records lane positions instead of broadcasting an id row); this case
+    # documents/confirms the trigger in isolation
+    ids = jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
+    idb = jnp.broadcast_to(ids, (64, T))
+    blk = jax.lax.slice_in_dim(idb, 0, 128, axis=1)          # [64, 128]
+    v = jnp.max(blk, axis=1).astype(jnp.float32)             # [64]
+    return jnp.broadcast_to(jnp.max(v)[None, None], (S, K)) + d2[:, :K] * 0.0
+
+
 def case_lane_extract(d2):
     lane = jax.lax.broadcasted_iota(jnp.int32, (S, T), 1)
     m = jnp.min(d2, axis=1)
@@ -91,10 +105,9 @@ def case_full_fold(d2):
     from mpi_cuda_largescaleknn_tpu.ops.pallas.knn_bf import (
         fold_tile_into_candidates,
     )
-    ids = jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
     cd2 = jnp.full((S, K), jnp.inf, jnp.float32)
     cidx = jnp.full((S, K), -1, jnp.int32)
-    cd2, cidx = fold_tile_into_candidates(d2, ids, cd2, cidx)
+    cd2, cidx = fold_tile_into_candidates(d2, 0, cd2, cidx)
     return cd2
 
 
@@ -102,5 +115,6 @@ if __name__ == "__main__":
     print(jax.devices(), flush=True)
     for nm, fn in [("min", case_min), ("lane_extract", case_lane_extract),
                    ("roll_concat", case_roll_concat), ("insert", case_insert),
-                   ("while", case_while), ("full_fold", case_full_fold)]:
+                   ("while", case_while), ("full_fold", case_full_fold),
+                   ("i32_row_bcast_s64", case_i32_row_bcast_s64)]:
         run_case(nm, fn)
