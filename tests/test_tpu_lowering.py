@@ -1,0 +1,87 @@
+"""Cross-platform TPU lowering of the Pallas kernels — no chip needed.
+
+``jax.export(..., platforms=["tpu"])`` runs the full JAX -> Mosaic-MLIR
+frontend pipeline (layout rules, op-support checks, the dynamic-slice
+rejections that round 4 could only discover on hardware) from a CPU-only
+process and embeds the Mosaic payload in a ``tpu_custom_call``. It does
+NOT run Mosaic's backend AOT compiler (tpu_compile_helper) — a backend
+crash like the round-5 i32-row-broadcast one still needs the chip probe
+(tools/tpu_probe.py) — but every *frontend* lowering regression fails
+here, in CI, at the exact geometries the bench and tune sweep use.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mpi_cuda_largescaleknn_tpu.core.types import CandidateState
+from mpi_cuda_largescaleknn_tpu.ops.candidates import init_candidates
+from mpi_cuda_largescaleknn_tpu.ops.partition import (
+    BucketedPoints,
+    coarsen_buckets,
+    partition_points,
+)
+from mpi_cuda_largescaleknn_tpu.ops.tiled import warm_start_self
+
+
+def _export_tiled(n, k, bucket_size, group, warm):
+    from mpi_cuda_largescaleknn_tpu.ops.pallas.knn_tiled import (
+        knn_update_tiled_pallas,
+    )
+
+    rng = np.random.default_rng(0)
+    pts = rng.random((n, 3)).astype(np.float32)
+    q = partition_points(jnp.asarray(pts), bucket_size=bucket_size)
+    pc = coarsen_buckets(q, group) if group > 1 else q
+    if warm:
+        st = warm_start_self(pc, k)
+    else:
+        st = init_candidates(q.num_buckets * q.bucket_size, k)
+
+    def f(st_d2, st_idx, qpts, qids, ppts, pids):
+        qq = BucketedPoints(qpts, qids, q.lower, q.upper, q.pos)
+        pp = BucketedPoints(ppts, pids, pc.lower, pc.upper, pc.pos)
+        out = knn_update_tiled_pallas(
+            CandidateState(st_d2, st_idx), qq, pp, interpret=False,
+            skip_self=jnp.int32(1 if warm else 0), self_group=group,
+            with_stats="full")
+        return out[0].dist2, out[0].idx, out[1], out[2]
+
+    args = (st.dist2, st.idx, q.pts, q.ids, pc.pts, pc.ids)
+    exp = jax.export.export(jax.jit(f), platforms=["tpu"])(*args)
+    assert b"tpu_custom_call" in exp.mlir_module_serialized
+    return exp
+
+
+@pytest.mark.parametrize(
+    "bucket_size,group,k,warm",
+    [
+        (512, 1, 8, True),    # bench default geometry (auto pallas bucket)
+        (64, 8, 8, True),     # the tune sweep's pair-budget geometry
+        (64, 8, 100, True),   # k=100: segmented fold (LSK_FOLD_SEGS path)
+        (256, 1, 8, False),   # cold heap, no coarsening (probe stage shape)
+    ],
+)
+def test_traversal_kernel_lowers_for_tpu(bucket_size, group, k, warm):
+    _export_tiled(16384, k, bucket_size, group, warm)
+
+
+def test_flat_kernel_lowers_for_tpu():
+    from mpi_cuda_largescaleknn_tpu.ops.pallas.knn_bf import knn_update_pallas
+
+    rng = np.random.default_rng(1)
+    q = rng.random((1024, 3)).astype(np.float32)
+    p = rng.random((4096, 3)).astype(np.float32)
+    st = init_candidates(1024, 8)
+
+    def f(d2, idx, q_, p_):
+        out = knn_update_pallas(CandidateState(d2, idx), q_, p_,
+                                query_tile=256, point_tile=2048,
+                                interpret=False)
+        return out.dist2, out.idx
+
+    exp = jax.export.export(jax.jit(f), platforms=["tpu"])(
+        st.dist2, st.idx, q, p)
+    assert b"tpu_custom_call" in exp.mlir_module_serialized

@@ -91,7 +91,11 @@ def _cells(quick: bool):
     # (escapes the bucket-size diagonal — docs/TUNING.md point_group row).
     # pair_budget_report.json (CPU-measured, platform-independent): at an
     # equal 512-lane tile, 64/G8 scores ~3x fewer pairs than 512/G1
-    for b, g in ((128, 4), (128, 8), (64, 8), (64, 16), (256, 2)):
+    # 64/G1 is the measured pair-budget winner (2,215 pairs/query) but its
+    # 64-lane tiles pad to 128 (2x lane waste); 64/G2 hits T=128 exactly —
+    # both compete with the wider-tile cells only the chip can rank
+    for b, g in ((128, 4), (128, 8), (64, 1), (64, 2), (64, 4), (64, 8),
+                 (64, 16), (256, 2)):
         cells.append({"engine": "pallas_tiled", "n": n8, "k": 8,
                       "bucket_size": b, "point_group": g,
                       "env": {"LSK_CHUNK_LANES": "2048"}})
@@ -107,6 +111,9 @@ def _cells(quick: bool):
     for b in BUCKETS:
         cells.append({"engine": "pallas_tiled", "n": n100, "k": 100,
                       "bucket_size": b, "env": {"LSK_CHUNK_LANES": "2048"}})
+    cells.append({"engine": "pallas_tiled", "n": n100, "k": 100,
+                  "bucket_size": 64, "point_group": 8,
+                  "env": {"LSK_CHUNK_LANES": "2048"}})
     cells.append({"engine": "tiled", "n": n100, "k": 100, "bucket_size": 512})
     return cells
 
