@@ -24,18 +24,19 @@ run_step() { # name timeout_s cmd...
 
 only=${1:-all}
 
-# 1. Probe: health + Mosaic-compile of every round-5 kernel addition
-#    (per-visit mask, skip-self, self_group, [1,1,2] stats, segmented fold).
-#    tpu_probe.py always exits 0 (stage errors go into its report), so the
-#    REAL gate is its on_tpu verdict: a CPU-fallback session must not burn
-#    the tune budget producing numbers step 5 would misread as on-chip.
-if [ "$only" = all ] || [ "$only" = probe ]; then
-  run_step probe 1800 python -u tools/tpu_probe.py || exit 1
-  grep -q '"on_tpu": true' $OUT/probe.out || {
-    log "probe reports on_tpu=false — aborting agenda (CPU backend)";
-    exit 1; }
+# Tunnel windows can be MINUTES long (01:02-01:05 UTC this session), so
+# the order banks evidence cheapest-first: a fast 250K bench (~60-90s
+# after contact, with bench.py's own in-attempt engine fallback serving
+# as the Mosaic gate), then the 1M deliverable, then the full probe
+# (warm_group matrix), then the tune sweep.
+
+# 1. Fast bench at 250K: banks SOME real-chip number for the new kernels
+#    within even a short window.
+if [ "$only" = all ] || [ "$only" = fast ]; then
+  run_step bench_fast 600 env BENCH_N=250000 BENCH_BUDGET_S=420 \
+      python bench.py
 fi
-[ "$only" = probe ] && exit 0
+[ "$only" = fast ] && exit 0
 
 # 2. THE deliverable: BENCH at 1M/k=8 on the chip (VERDICT item 1).
 #    bench.py self-checks and falls back with stage attribution.
@@ -45,20 +46,33 @@ if [ "$only" = all ] || [ "$only" = bench ]; then
 fi
 [ "$only" = bench ] && exit 0
 
-# 3. Tune sweep (VERDICT item 2): crossed geometry grid at 500K + 1M
+# 3. Probe: health + Mosaic-compile of every round-5 kernel addition
+#    (position fold, per-visit mask, skip-self, self_group, [1,1,2] stats,
+#    segmented fold at the bucket-64 geometry that crashed the AOT
+#    backend pre-refactor). tpu_probe.py always exits 0 (stage errors go
+#    into its report); its on_tpu verdict gates the tune sweep below.
+if [ "$only" = all ] || [ "$only" = probe ]; then
+  run_step probe 1800 python -u tools/tpu_probe.py || exit 1
+  grep -q '"on_tpu": true' $OUT/probe.out || {
+    log "probe reports on_tpu=false — aborting agenda (CPU backend)";
+    exit 1; }
+fi
+[ "$only" = probe ] && exit 0
+
+# 4. Tune sweep (VERDICT item 2): crossed geometry grid at 500K + 1M
 #    confirms; checkpoints tpu_tune_report.json after every cell.
 if [ "$only" = all ] || [ "$only" = tune ]; then
   run_step tune 14400 python -u tools/tpu_tune.py
 fi
 [ "$only" = tune ] && exit 0
 
-# 4. k=100 on chip (VERDICT item 4): bench at the reference's canonical k.
+# 5. k=100 on chip (VERDICT item 4): bench at the reference's canonical k.
 if [ "$only" = all ] || [ "$only" = k100 ]; then
   run_step bench_1m_k100 2400 env BENCH_K=100 BENCH_BUDGET_S=1800 \
       python bench.py
 fi
 
-# 5. Re-bench 1M/k=8 with the tune winner (read tpu_tune_report.json by
+# 6. Re-bench 1M/k=8 with the tune winner (read tpu_tune_report.json by
 #    hand and export BENCH_BUCKET_SIZE/BENCH_POINT_GROUP/LSK_CHUNK_LANES
 #    before invoking: bash round5/chip_session.sh best).
 if [ "$only" = best ]; then

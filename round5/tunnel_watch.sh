@@ -11,7 +11,7 @@ while true; do
   # rc=0 ONLY for a real accelerator: a fast CPU fallback (plugin error
   # instead of tunnel hang) must keep the watcher alive, not fire the
   # one-shot agenda on the host backend
-  timeout 300 python -c "
+  timeout 120 python -c "
 import sys, time, jax
 t0=time.time()
 ds = jax.devices()
@@ -28,5 +28,5 @@ sys.exit(0 if ds and ds[0].platform != 'cpu' else 2)
     echo "chip agenda exited $(date -u +%FT%TZ)" >> $LOG
     exit 0
   fi
-  sleep 45
+  sleep 15
 done
