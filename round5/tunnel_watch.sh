@@ -11,7 +11,7 @@ while true; do
   # rc=0 ONLY for a real accelerator: a fast CPU fallback (plugin error
   # instead of tunnel hang) must keep the watcher alive, not fire the
   # one-shot agenda on the host backend
-  timeout 120 python -c "
+  timeout 240 python -c "
 import sys, time, jax
 t0=time.time()
 ds = jax.devices()

@@ -60,7 +60,8 @@ def main() -> int:
     cells = []
     for k in (8, 100):
         for b, g in ((512, 1), (256, 1), (128, 1), (64, 1),
-                     (128, 4), (128, 8), (64, 8), (256, 2)):
+                     (128, 4), (128, 8), (64, 2), (64, 4), (64, 8),
+                     (64, 16), (256, 2)):
             cells.append((n, k, b, g))
     # size-invariance check rows (k=8, best-guess geometry)
     for nn in (62_500, 125_000, 500_000):
