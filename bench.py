@@ -40,6 +40,20 @@ K = int(os.environ.get("BENCH_K", 8))
 BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", 900))
 CPU_RESERVE_S = 150.0  # kept back for the labeled cpu-fallback measurement
 
+# Persistent XLA compile cache, shared by every child (and by tune/probe
+# runs in the same session): a timed-out attempt that got past
+# warmup_done retries for the cost of a cache load, and later tune cells
+# at the same geometry skip compile entirely. Rationale + keying in
+# utils/compile_cache.py; set here (parent) so children inherit the env.
+# Cross-process cache hits verified on the axon TPU backend itself
+# (jit matmul: 1.97s cold -> 0.27s in a fresh process; entries written
+# to .jax_cache). Whether Mosaic AOT kernels also hit it is confirmed
+# per-session from warmup_done deltas in the probe_log.
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from mpi_cuda_largescaleknn_tpu.utils.compile_cache import (  # noqa: E402
+    enable_persistent_cache)
+enable_persistent_cache()
+
 _CHILD = r"""
 import json, os, sys, time
 import numpy as np
@@ -128,7 +142,8 @@ for n in ladder:
         out = model.run(pts)  # warm the compile cache at full shape
         compile_s = time.perf_counter() - t0
         print("STAGE " + json.dumps(
-            {"warmup_done": {"n": n, "seconds": round(compile_s, 1)}}),
+            {"warmup_done": {"n": n, "engine": eng,
+                             "seconds": round(compile_s, 1)}}),
             flush=True)
         best, ring_s = float("inf"), None
         for _ in range(reps):
@@ -277,11 +292,21 @@ def main() -> int:
             # the retry must not re-run the rung that hung: the stage lines
             # name the last rung started; drop it and everything larger.
             # No stage lines = the hang was first contact, not a rung —
-            # keep the ladder and retry as-is (tunnels recover)
-            started = [s["warmup_start"]["n"] for s in got["stages"]
-                       if "warmup_start" in s]
-            if started:
-                ladder_now = [n for n in ladder_now if n < started[-1]]
+            # keep the ladder and retry as-is (tunnels recover).
+            # Exception: if the hung (n, engine) pair had REACHED
+            # warmup_done, its compile is now in the persistent cache —
+            # the retry re-runs the same rung and pays only a cache load
+            # + the timed reps. Keyed on engine too: a cached pallas
+            # compile must not mask a timeout inside the fallback
+            # engine's still-uncached compile at the same n.
+            started = [(s["warmup_start"]["n"],
+                        s["warmup_start"].get("engine"))
+                       for s in got["stages"] if "warmup_start" in s]
+            compiled = {(s["warmup_done"].get("n"),
+                         s["warmup_done"].get("engine"))
+                        for s in got["stages"] if "warmup_done" in s}
+            if started and started[-1] not in compiled:
+                ladder_now = [n for n in ladder_now if n < started[-1][0]]
 
     # --- CPU fallback, clearly labeled -------------------------------------
     if result is None:

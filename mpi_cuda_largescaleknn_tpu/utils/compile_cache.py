@@ -1,0 +1,33 @@
+"""Persistent XLA compile-cache bootstrap, shared by bench/tune/probe.
+
+On-chip full-model compile was measured at ~220s for the 250K config
+(round5/chip/bench_fast.out) — it dominates any attempt budget. Enabling
+jax's persistent compilation cache makes a completed compile survive the
+process, so bench retries, tune cells at a repeated geometry, and
+separate agenda steps pay it once per session. Entries are keyed by
+HLO + backend, so cpu-fallback and TPU programs coexist in one dir.
+
+Must be called BEFORE the first jax import in the process (env vars are
+read at backend init). All call sites use `setdefault`, so an operator
+export (e.g. round5/chip_session.sh) always wins.
+"""
+from __future__ import annotations
+
+import os
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def enable_persistent_cache(cache_dir: str | None = None) -> str:
+    """Point JAX_COMPILATION_CACHE_DIR at a repo-local dir (idempotent)."""
+    cache_dir = os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        cache_dir or os.path.join(_REPO_ROOT, ".jax_cache"))
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+    except OSError:
+        pass  # unwritable dir: jax warns and runs uncached
+    return cache_dir

@@ -1,0 +1,10 @@
+#!/bin/bash
+# Session-2 chained agenda: 1M bench first (THE deliverable), then probe
+# (gates tune), tune sweep, k=100, then a 250K fast number. Each step via
+# chip_session.sh so all logging/caps/cache exports stay in one place.
+cd /root/repo
+bash round5/chip_session.sh bench
+bash round5/chip_session.sh probe && bash round5/chip_session.sh tune
+bash round5/chip_session.sh k100
+bash round5/chip_session.sh fast
+echo "agenda2 complete $(date -u +%FT%TZ)" >> round5/chip/session.log

@@ -8,6 +8,13 @@
 #   bash round5/chip_session.sh probe      # just the probe
 set -u
 cd /root/repo
+# Persistent XLA compile cache for every step (bench children, probe,
+# tune cells): on-chip full-model compile measured at ~220s for 250K —
+# pay it once per shape/geometry for the whole session.
+export JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1
+export JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES=0
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 OUT=round5/chip
 mkdir -p $OUT
 stamp() { date -u +%FT%TZ; }

@@ -19,6 +19,11 @@ import traceback
 # `python tools/tpu_probe.py` puts tools/ (not the repo root) on sys.path
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from mpi_cuda_largescaleknn_tpu.utils.compile_cache import (  # noqa: E402
+    enable_persistent_cache)
+
+enable_persistent_cache()  # before the first jax import (stages import jax)
+
 REPORT = {}
 
 

@@ -27,6 +27,13 @@ import os
 import subprocess
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from mpi_cuda_largescaleknn_tpu.utils.compile_cache import (  # noqa: E402
+    enable_persistent_cache)
+
+# Children inherit the env: repeated-geometry cells skip XLA compile.
+enable_persistent_cache()
+
 _CHILD = r"""
 import json, sys, time
 import numpy as np
