@@ -1,0 +1,70 @@
+"""utils/compile_cache.py — env bootstrap semantics.
+
+The helper must (a) default the cache dir to a repo-local path and create
+it, (b) never override an operator-exported value (chip_session.sh sets
+its own), and (c) stay idempotent so bench.py's parent + child and the
+standalone tools can all call it. Pure env manipulation — no jax import,
+so these run instantly on the CPU fixture.
+"""
+
+import os
+
+from mpi_cuda_largescaleknn_tpu.utils.compile_cache import (
+    _REPO_ROOT, enable_persistent_cache)
+
+_VARS = ("JAX_COMPILATION_CACHE_DIR",
+         "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+         "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES")
+
+
+def _clear(monkeypatch):
+    # setitem-then-delitem (not bare delenv): setitem records the var's
+    # ORIGINAL state — including absence — so values the code under test
+    # writes into os.environ are rolled back at teardown instead of
+    # leaking a deleted tmp cache dir into later jax-importing tests
+    for v in _VARS:
+        monkeypatch.setitem(os.environ, v, "sentinel")
+        monkeypatch.delitem(os.environ, v)
+
+
+def test_defaults_to_repo_local_dir_and_creates_it(monkeypatch, tmp_path):
+    _clear(monkeypatch)
+    target = str(tmp_path / "cache")
+    got = enable_persistent_cache(target)
+    assert got == target == os.environ["JAX_COMPILATION_CACHE_DIR"]
+    assert os.path.isdir(target)
+    assert os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] == "1"
+    assert os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"] == "0"
+
+
+def test_operator_export_wins(monkeypatch, tmp_path):
+    _clear(monkeypatch)
+    theirs = str(tmp_path / "operator")
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", theirs)
+    monkeypatch.setenv("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "7")
+    got = enable_persistent_cache(str(tmp_path / "mine"))
+    assert got == theirs == os.environ["JAX_COMPILATION_CACHE_DIR"]
+    assert os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] == "7"
+
+
+def test_idempotent_and_repo_root_resolves(monkeypatch, tmp_path):
+    _clear(monkeypatch)
+    target = str(tmp_path / "cache")
+    assert enable_persistent_cache(target) == enable_persistent_cache(target)
+    # the default path is anchored at the repo root (where bench.py lives)
+    assert os.path.isfile(os.path.join(_REPO_ROOT, "bench.py"))
+
+
+def test_unwritable_dir_does_not_raise(monkeypatch, tmp_path):
+    _clear(monkeypatch)
+
+    # forced failure, not a chmod'd dir: root (this container's uid)
+    # ignores permission bits, which would leave the swallow path untested
+    def _boom(*a, **kw):
+        raise OSError("unwritable")
+
+    import mpi_cuda_largescaleknn_tpu.utils.compile_cache as cc
+    monkeypatch.setattr(cc.os, "makedirs", _boom)
+    # helper must swallow the OSError (jax itself warns and runs uncached)
+    got = enable_persistent_cache(str(tmp_path / "cache"))
+    assert got == os.environ["JAX_COMPILATION_CACHE_DIR"]
