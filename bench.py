@@ -108,8 +108,10 @@ if not cfg_kw and platform != "cpu":
         if _cells:
             _best = max(_cells, key=lambda r: (r.get("n", 0), r["qps"]))
             tuned_kw["bucket_size"] = _best["bucket_size"]
-            if _best.get("point_group", 1) > 1:
-                tuned_kw["point_group"] = _best["point_group"]
+            # always explicit: sweep cells without the key ran G1, and
+            # leaving it unset would let the config's 0=auto default
+            # substitute a different (unswept) group for the adopted cell
+            tuned_kw["point_group"] = _best.get("point_group", 1)
             _lanes = (_best.get("env") or {}).get("LSK_CHUNK_LANES")
             if _lanes and not os.environ.get("LSK_CHUNK_LANES"):
                 os.environ["LSK_CHUNK_LANES"] = str(_lanes)

@@ -26,8 +26,9 @@ TPU-side options (no reference analogue):
   --bucket-size N   points per spatial bucket (tiled engines; default
                     auto: engine-tuned, see docs/TUNING.md)
   --point-group N   coarsen the resident point side by this power-of-two
-                    factor (tiled engines; default 1; chunked runs coarsen
-                    the resident side only)
+                    factor (tiled engines; default auto: engine-tuned,
+                    pass 1 to disable — see docs/TUNING.md; chunked runs
+                    coarsen the resident side only)
   --query-chunk N   stream queries in chunks of N rows per device;
                     bounds candidate-heap memory to N*k per device for runs
                     whose heaps exceed HBM (e.g. -k 100 at 100M+ points)
@@ -68,7 +69,7 @@ def parse_args(program: str, argv: list[str]):
     in_path = ""
     out_path = ""
     extras = {"shards": None, "engine": "auto", "query_tile": 2048,
-              "point_tile": 2048, "bucket_size": 0, "point_group": 1,
+              "point_tile": 2048, "bucket_size": 0, "point_group": 0,
               "profile_dir": None,
               "timings": False, "checkpoint_dir": None, "checkpoint_every": 1,
               "write_indices": None, "query_chunk": 0, "selfcheck": 0,

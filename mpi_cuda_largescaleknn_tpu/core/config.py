@@ -33,12 +33,14 @@ class KnnConfig:
                                      # bucket; 0 = auto per engine from
                                      # measured data (parallel/ring.py
                                      # resolve_bucket_size: twin 128,
-                                     # pallas 512)
-    point_group: int = 1             # tiled self-join drivers: coarsen the
+                                     # pallas 256 — round-5 tune sweep)
+    point_group: int = 0             # tiled self-join drivers: coarsen the
                                      # point side by this power-of-two factor
                                      # (fine query buckets -> tighter prune
                                      # radius; wide resident tiles -> DMA and
-                                     # fold efficiency; docs/TUNING.md)
+                                     # fold efficiency; docs/TUNING.md).
+                                     # 0 = auto per engine (_effective_group:
+                                     # pallas G2 per the tune sweep, else 1)
     num_shards: int = 1              # size of the 1-D mesh axis
     query_chunk: int = 0             # >0: stream queries in chunks of this
                                      # many rows/device (bounds heap memory
@@ -56,6 +58,7 @@ class KnnConfig:
                                "tree", "pallas"):
             raise ValueError(f"unknown engine '{self.engine}'")
         pg = self.point_group
-        if pg < 1 or (pg & (pg - 1)) != 0:
+        if pg < 0 or (pg and (pg & (pg - 1)) != 0):
             raise ValueError(
-                f"point_group must be a power of two >= 1, got {pg}")
+                "point_group must be 0 (auto) or a power of two >= 1, "
+                f"got {pg}")

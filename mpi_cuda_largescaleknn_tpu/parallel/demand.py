@@ -348,7 +348,7 @@ def demand_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
     bucket_size = resolve_bucket_size(bucket_size, engine)
     num_shards = mesh.shape[AXIS]
     npad = points_sharded.shape[0] // num_shards
-    point_group = _effective_group(point_group, npad, bucket_size)
+    point_group = _effective_group(point_group, npad, bucket_size, engine)
     init_fn, round_fn, final_fn, _sif, _qif, init_from_q, _qfq = \
         _make_demand_fns(k, max_radius, engine, query_tile, point_tile,
                          bucket_size, num_shards, warm_start=True,
@@ -438,7 +438,7 @@ def demand_knn_stepwise(points_sharded: jnp.ndarray,
     bucket_size = resolve_bucket_size(bucket_size, engine)
     num_shards = mesh.shape[AXIS]
     npad = points_sharded.shape[0] // num_shards
-    point_group = _effective_group(point_group, npad, bucket_size)
+    point_group = _effective_group(point_group, npad, bucket_size, engine)
     spec = P(AXIS)
     check_vma = not engine.startswith("pallas")
     sharding = NamedSharding(mesh, spec)
@@ -457,8 +457,11 @@ def demand_knn_stepwise(points_sharded: jnp.ndarray,
         fp = ckpt.fingerprint(
             n=int(pts.shape[0]), k=int(k), shards=num_shards, engine=engine,
             max_radius=float(max_radius), bucket_size=bucket_size,
-            # key present only when active: default-group runs keep
-            # resumability of checkpoints written before the knob existed
+            # key present only when active (G>1): G1 runs keep
+            # resumability of checkpoints written before the knob
+            # existed; pallas DEFAULT runs resolve to G2 since the
+            # round-5 retune (flags to resume older ones:
+            # ring.resolve_bucket_size docstring)
             **({"point_group": point_group} if point_group > 1 else {}),
             query_tile=query_tile, point_tile=point_tile,
             # -rg: counts carry [kernels, rotations] — older single-counter
@@ -605,7 +608,7 @@ def demand_knn_chunked(points_sharded: jnp.ndarray,
         # group-coarsened per device (wide tiles, no skip-self needed —
         # see ring_knn_chunked)
         q_full = partition_sharded(pts, ids, mesh, bucket_size)
-        pgc = _effective_group(point_group, npad, bucket_size)
+        pgc = _effective_group(point_group, npad, bucket_size, engine)
         if pgc > 1:
             q_full = smap(partial(coarsen_buckets, group=pgc),
                           1, spec)(q_full)

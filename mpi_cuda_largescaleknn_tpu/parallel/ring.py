@@ -164,17 +164,28 @@ def resolve_bucket_size(bucket_size: int, engine: str) -> int:
     pair-budget-bound on its low-overhead backend (CPU wall-clock tracks
     pairs/query 1:1 — bucket 128 doubled 250K/k=8 throughput over 512,
     round-5 geometry sweep + pair_budget_report.json), while the Pallas
-    kernel pays a real per-while-step cost that favors wider tiles — it
-    keeps 512 until tpu_tune.py's on-chip data says otherwise.
+    kernel pays a real per-while-step cost that favors wider tiles —
+    tpu_tune.py's on-chip sweep ranked 256 (with G2) first.
 
-    Checkpoint note: stepwise fingerprints record the RESOLVED value (a
+    Checkpoint note: stepwise fingerprints record the RESOLVED values (a
     different bucket geometry is genuinely non-resumable state — the
     partitioned shard arrays change shape), so changing an auto default
-    here makes older default-flag checkpoints resumable only by passing
-    the explicit --bucket-size the fingerprint error names."""
+    here (or in _effective_group) makes older default-flag checkpoints
+    resumable only by passing the explicit flags of the recorded
+    geometry: for pallas runs from before the round-5 retune, both
+    `--bucket-size 512` and `--point-group 1`."""
     if bucket_size:
         return bucket_size
-    return 128 if engine == "tiled" else 512
+    if engine == "tiled":
+        return 128
+    if engine == "pallas_tiled":
+        # tpu_tune.py on-chip sweep (round 5, v5e, 500K/k=8): 256-bucket
+        # cells beat the old 512 default at every LSK_CHUNK_LANES, and
+        # the 256/G2 geometry won the whole grid (552.7K q/s vs 512/G1's
+        # 356.3K) — see tpu_tune_report.json; G2 comes from the
+        # point_group auto below.
+        return 256
+    return 512
 
 
 def _tiled_engine_fn(engine: str):
@@ -387,9 +398,20 @@ def ring_total_rounds(num_shards: int) -> int:
 
 
 def _effective_group(point_group: int, npad_local: int,
-                     bucket_size: int) -> int:
+                     bucket_size: int, engine: str) -> int:
     """Clamp the point-side coarsening factor to the actual bucket count
-    (both are powers of two, so the clamped value always divides)."""
+    (both are powers of two, so the clamped value always divides).
+
+    0 = auto per engine, like resolve_bucket_size: the Pallas kernel's
+    tune-sweep winner pairs its 256-bucket default with G2 (fine prune
+    radius, full-width 512-lane tiles — tpu_tune_report.json round 5);
+    the XLA twin's lock-step visit loop measurably loses from grouping
+    (BASELINE.md round-5 A/B), so every other engine resolves to 1.
+    ``engine`` is deliberately required: a call site that forgot it
+    would silently resolve auto to 1 instead of the engine's tuned
+    group (checkpoint-recovery implications in resolve_bucket_size)."""
+    if point_group == 0:
+        point_group = 2 if engine == "pallas_tiled" else 1
     if point_group <= 1:
         return 1
     assert point_group & (point_group - 1) == 0, point_group
@@ -475,7 +497,7 @@ def ring_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
     num_shards = mesh.shape[AXIS]
     total_rounds = ring_total_rounds(num_shards)
     npad_local = points_sharded.shape[0] // num_shards
-    point_group = _effective_group(point_group, npad_local, bucket_size)
+    point_group = _effective_group(point_group, npad_local, bucket_size, engine)
     init_fn, round_fn, final_fn, _sif, _qif, init_from_q, _qfq = \
         _make_ring_fns(k, max_radius, engine, query_tile, point_tile,
                        bucket_size, num_shards, warm_start=True,
@@ -582,7 +604,7 @@ def ring_knn_stepwise(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
     spec = P(AXIS)
     check_vma = not engine.startswith("pallas")
     npad_local = points_sharded.shape[0] // num_shards
-    point_group = _effective_group(point_group, npad_local, bucket_size)
+    point_group = _effective_group(point_group, npad_local, bucket_size, engine)
 
     def smap(fn, n_in, out_structs):
         return jax.jit(jax.shard_map(
@@ -599,8 +621,12 @@ def ring_knn_stepwise(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
         fp = ckpt.fingerprint(
             n=int(pts.shape[0]), k=int(k), shards=num_shards, engine=engine,
             max_radius=float(max_radius), bucket_size=bucket_size,
-            # key present only when active: default-group runs keep
-            # resumability of checkpoints written before the knob existed
+            # key present only when active (G>1): G1 runs keep
+            # resumability of checkpoints written before the knob
+            # existed. Since the round-5 retune, pallas DEFAULT runs
+            # resolve to G2 and so do record the key — older default
+            # checkpoints need the explicit flags resolve_bucket_size's
+            # docstring names.
             **({"point_group": point_group} if point_group > 1 else {}),
             query_tile=query_tile, point_tile=point_tile, ring="bidir",
             data=ckpt.data_digest(points_sharded, ids_sharded))
@@ -763,7 +789,7 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
     my_pos = sorted(pts_b)
     n_my = len(my_pos)
     n_chunks = max(1, -(-npad_local // chunk_rows))
-    point_group = _effective_group(point_group, npad_local, bucket_size)
+    point_group = _effective_group(point_group, npad_local, bucket_size, engine)
 
     def to_global(local, global_rows):
         if multi:
