@@ -335,7 +335,7 @@ def demand_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
                mesh, *, max_radius: float = jnp.inf,
                engine: str = "auto", query_tile: int = 2048,
                point_tile: int = 2048, bucket_size: int = 0,
-               point_group: int = 1, return_stats: bool = False):
+               point_group: int = 0, return_stats: bool = False):
     """Bounds-pruned kNN over pre-partitioned shards on a 1-D mesh (fused
     on-device ``lax.while_loop``).
 
@@ -419,7 +419,7 @@ def demand_knn_stepwise(points_sharded: jnp.ndarray,
                         ids_sharded: jnp.ndarray, k: int, mesh, *,
                         max_radius: float = jnp.inf, engine: str = "auto",
                         query_tile: int = 2048, point_tile: int = 2048,
-                        bucket_size: int = 0, point_group: int = 1,
+                        bucket_size: int = 0, point_group: int = 0,
                         checkpoint_dir: str | None = None,
                         checkpoint_every: int = 1,
                         max_rounds: int | None = None,
@@ -552,7 +552,7 @@ def demand_knn_chunked(points_sharded: jnp.ndarray,
                        chunk_rows: int, max_radius: float = jnp.inf,
                        engine: str = "auto", query_tile: int = 2048,
                        point_tile: int = 2048, bucket_size: int = 0,
-                       point_group: int = 1,
+                       point_group: int = 0,
                        checkpoint_dir: str | None = None,
                        checkpoint_every: int = 1,
                        return_candidates: bool = False,

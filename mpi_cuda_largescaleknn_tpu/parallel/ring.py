@@ -473,7 +473,7 @@ def _ring_stats(engine: str, tiles_total: int, bucket_size: int,
 def ring_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
              mesh, *, max_radius: float = jnp.inf, engine: str = "auto",
              query_tile: int = 2048, point_tile: int = 2048,
-             bucket_size: int = 0, point_group: int = 1,
+             bucket_size: int = 0, point_group: int = 0,
              return_candidates: bool = False,
              return_stats: bool = False):
     """Run the full R-round ring on a 1-D mesh (fused ``lax.fori_loop``).
@@ -572,7 +572,7 @@ def ring_knn_stepwise(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
                       k: int, mesh, *, max_radius: float = jnp.inf,
                       engine: str = "auto", query_tile: int = 2048,
                       point_tile: int = 2048, bucket_size: int = 0,
-                      point_group: int = 1,
+                      point_group: int = 0,
                       checkpoint_dir: str | None = None,
                       checkpoint_every: int = 1,
                       max_rounds: int | None = None,
@@ -712,7 +712,7 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
                      k: int, mesh, *, chunk_rows: int,
                      max_radius: float = jnp.inf, engine: str = "auto",
                      query_tile: int = 2048, point_tile: int = 2048,
-                     bucket_size: int = 0, point_group: int = 1,
+                     bucket_size: int = 0, point_group: int = 0,
                      checkpoint_dir: str | None = None,
                      checkpoint_every: int = 1,
                      max_chunks: int | None = None,

@@ -1,0 +1,39 @@
+#!/bin/bash
+# Window agenda #3 — what the 04:05 outage killed, cheapest-headline
+# first. Run ONLY via watch3.sh (single-client tunnel).
+set -u
+cd /root/repo
+export JAX_COMPILATION_CACHE_DIR=/root/repo/.jax_cache
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1
+export JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES=0
+OUT=round5/chip
+stamp() { date -u +%FT%TZ; }
+log() { echo "[$(stamp)] $*" | tee -a $OUT/session.log; }
+run_step() { # name timeout_s cmd...
+  local name=$1 tmo=$2; shift 2
+  log "START $name"
+  timeout "$tmo" "$@" > "$OUT/$name.out" 2> "$OUT/$name.err"
+  local rc=$?
+  log "END $name rc=$rc"
+  return $rc
+}
+
+# 1. THE headline upgrade: 1M/k=8 at the tuned 256/G2 auto default
+#    (bench auto-adopts the committed tune winner; expect >550K q/s).
+run_step bench_1m_k8_tuned 1700 env BENCH_BUDGET_S=1500 python bench.py
+
+# 2. Targeted tune cells the outage killed: 1M confirms (k8 winner pair
+#    + k100 winner) and the two missed k=100 sweep cells. The k8-winner
+#    confirm reuses step 1's compile via the cache.
+run_step tune_missed 3600 python -u tools/tpu_tune.py \
+    --cells round5/missed_cells.json
+
+# 3. k=100 at 1M on chip (VERDICT item 4's real target).
+run_step bench_1m_k100_tuned 2200 env BENCH_K=100 BENCH_BUDGET_S=2000 \
+    python bench.py
+
+# 4. 250K fast number at the tuned geometry.
+run_step bench_250k_tuned 800 env BENCH_N=250000 BENCH_BUDGET_S=600 \
+    python bench.py
+
+log "agenda3 complete"

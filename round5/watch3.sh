@@ -1,0 +1,28 @@
+#!/bin/bash
+# Retry TPU contact; on success run agenda3 once, then KEEP WATCHING
+# (windows recur — later contact re-runs any step whose .out lacks a
+# TPU result is manual; this watcher only fires the agenda once).
+LOCK=/root/repo/round5/.watch3.lock
+exec 9>"$LOCK"
+flock -n 9 || { echo "another watcher holds $LOCK" >&2; exit 1; }
+LOG=/root/repo/round5/tunnel_watch.log
+echo "watch3 start $(date -u +%FT%TZ)" >> $LOG
+while true; do
+  timeout 150 python -c "
+import sys, time, jax
+t0=time.time()
+ds = jax.devices()
+print('CONTACT', round(time.time()-t0,1), [str(d) for d in ds],
+      ds[0].device_kind)
+sys.exit(0 if ds and ds[0].platform != 'cpu' else 2)
+" >> $LOG 2>&1
+  rc=$?
+  echo "attempt rc=$rc $(date -u +%FT%TZ)" >> $LOG
+  if [ $rc -eq 0 ]; then
+    echo "TUNNEL UP -> agenda3 $(date -u +%FT%TZ)" >> $LOG
+    bash /root/repo/round5/agenda3.sh >> $LOG 2>&1
+    echo "agenda3 exited $(date -u +%FT%TZ)" >> $LOG
+    exit 0
+  fi
+  sleep 20
+done

@@ -155,6 +155,37 @@ def _run_cell(spec, results):
 
 def main() -> int:
     quick = "--quick" in sys.argv
+    if "--cells" in sys.argv:
+        # targeted re-runs (e.g. cells a tunnel outage killed mid-sweep):
+        # JSON file of spec dicts; successful rows merge into the existing
+        # checkpointed report instead of restarting the whole grid
+        idx = sys.argv.index("--cells") + 1
+        if idx >= len(sys.argv):
+            sys.stderr.write("usage: tpu_tune.py --cells <specs.json>\n")
+            return 2
+        with open(sys.argv[idx]) as f:
+            specs = json.load(f)
+
+        def _key(row):
+            # identity of a measurement cell = its full spec (qps etc.
+            # are results, not identity)
+            return json.dumps(
+                {kk: row.get(kk) for kk in
+                 ("engine", "n", "k", "bucket_size", "point_group",
+                  "query_tile", "point_tile", "env", "confirm")},
+                sort_keys=True)
+
+        rerun = {_key(s) for s in specs}
+        try:
+            with open("tpu_tune_report.json") as f:
+                # drop stale rows being re-measured (and old error rows)
+                results = [r for r in json.load(f)
+                           if "qps" in r and _key(r) not in rerun]
+        except (OSError, ValueError):
+            results = []
+        for spec in specs:
+            _run_cell(spec, results)
+        return 0
     results = []
     for spec in _cells(quick):
         _run_cell(spec, results)
