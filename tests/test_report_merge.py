@@ -1,0 +1,183 @@
+"""Report-integrity of the targeted re-run modes.
+
+`tools/tpu_tune.py --cells` and `benchmarks.py --only` both merge
+re-measured rows into a checkpointed report that holds scarce on-chip
+data — a merge bug silently destroys measurements a tunnel outage makes
+unrepeatable. These tests drive the real main() entry points with the
+child subprocess mocked (no jax, no tunnel), asserting the protection
+properties: replace-by-identity, no duplicates, never clobber a good row
+with a failure, stable ordering, honest top-level flags.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+import types
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name, path):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture()
+def tune(monkeypatch, tmp_path):
+    mod = _load("tpu_tune_under_test", os.path.join(_REPO, "tools",
+                                                    "tpu_tune.py"))
+    monkeypatch.setattr(mod, "REPORT_PATH", str(tmp_path / "tune.json"))
+    return mod
+
+
+@pytest.fixture()
+def suite(monkeypatch, tmp_path):
+    mod = _load("benchmarks_under_test", os.path.join(_REPO,
+                                                      "benchmarks.py"))
+    monkeypatch.setattr(mod, "REPORT_PATH", str(tmp_path / "suite.json"))
+    monkeypatch.setattr(mod, "_tpu_ok", lambda *a, **kw: False)
+    return mod
+
+
+def _fake_run(result_for):
+    """subprocess.run stand-in: RESULT line per spec, or a failure."""
+    def run(argv, **kw):
+        spec = json.loads(argv[-1])
+        out = result_for(spec)
+        if out is None:
+            return types.SimpleNamespace(returncode=1, stdout="",
+                                         stderr="boom")
+        return types.SimpleNamespace(
+            returncode=0, stdout="RESULT " + json.dumps(out) + "\n",
+            stderr="")
+    return run
+
+
+def test_cells_replaces_matching_spec_without_duplicates(tune, monkeypatch,
+                                                         tmp_path):
+    prior = [
+        {"engine": "pallas_tiled", "n": 500, "k": 8, "bucket_size": 256,
+         "point_group": 2, "env": {"LSK_CHUNK_LANES": "2048"}, "qps": 100.0},
+        {"engine": "pallas_tiled", "n": 500, "k": 8, "bucket_size": 64,
+         "env": {"LSK_CHUNK_LANES": "2048"}, "qps": 50.0},
+        {"engine": "pallas_tiled", "n": 500, "k": 100, "bucket_size": 512,
+         "env": {"LSK_CHUNK_LANES": "2048"}, "error": "timeout"},
+    ]
+    with open(tune.REPORT_PATH, "w") as f:
+        json.dump(prior, f)
+    cells = tmp_path / "cells.json"
+    # re-measure the first spec (same identity, new qps)
+    respec = {k: v for k, v in prior[0].items() if k != "qps"}
+    cells.write_text(json.dumps([respec]))
+
+    monkeypatch.setattr(
+        tune.subprocess, "run",
+        _fake_run(lambda s: {**s, "qps": 999.0, "platform": "tpu"}))
+    monkeypatch.setattr(sys, "argv", ["tpu_tune.py", "--cells", str(cells)])
+    assert tune.main() == 0
+
+    rows = json.load(open(tune.REPORT_PATH))
+    assert len([r for r in rows if r.get("bucket_size") == 256]) == 1
+    assert [r["qps"] for r in rows if r.get("bucket_size") == 256] == [999.0]
+    # untouched good row survives; prior error row is dropped
+    assert any(r.get("bucket_size") == 64 and r["qps"] == 50.0 for r in rows)
+    assert not any("error" in r for r in rows)
+
+
+def test_cells_failed_rerun_keeps_prior_good_row(tune, monkeypatch,
+                                                 tmp_path):
+    prior = [
+        {"engine": "pallas_tiled", "n": 500, "k": 8, "bucket_size": 64,
+         "env": {"LSK_CHUNK_LANES": "2048"}, "qps": 50.0},
+    ]
+    with open(tune.REPORT_PATH, "w") as f:
+        json.dump(prior, f)
+    cells = tmp_path / "cells.json"
+    cells.write_text(json.dumps(
+        [{k: v for k, v in prior[0].items() if k != "qps"}]))
+
+    monkeypatch.setattr(tune.subprocess, "run", _fake_run(lambda s: None))
+    monkeypatch.setattr(sys, "argv", ["tpu_tune.py", "--cells", str(cells)])
+    assert tune.main() == 0
+    rows = json.load(open(tune.REPORT_PATH))
+    assert [r["qps"] for r in rows] == [50.0]  # crash did not clobber
+
+
+def test_cells_missing_path_is_usage_error(tune, monkeypatch):
+    monkeypatch.setattr(sys, "argv", ["tpu_tune.py", "--cells"])
+    assert tune.main() == 2
+
+
+def test_only_merge_preserves_other_rows_and_order(suite, monkeypatch,
+                                                   tmp_path):
+    prior = {"full": True, "tpu_available": False, "results": [
+        {"config": "unordered_1dev_k8", "queries_per_sec": 1.0},
+        {"config": "unordered_1dev_k32", "queries_per_sec": 2.0},
+        {"config": "unordered_1dev_k100", "error": "timeout"},
+    ]}
+    with open(suite.REPORT_PATH, "w") as f:
+        json.dump(prior, f)
+
+    monkeypatch.setattr(
+        suite.subprocess, "run",
+        _fake_run(lambda s: {"config": s["name"], "queries_per_sec": 42.0}))
+    monkeypatch.setattr(sys, "argv",
+                        ["benchmarks.py", "--full", "--only", "k100"])
+    assert suite.main() == 0
+
+    rep = json.load(open(suite.REPORT_PATH))
+    names = [r["config"] for r in rep["results"]]
+    # canonical order kept: k8, k32, k100 stay in config-list order
+    assert names[:3] == ["unordered_1dev_k8", "unordered_1dev_k32",
+                         "unordered_1dev_k100"]
+    by = {r["config"]: r for r in rep["results"]}
+    assert by["unordered_1dev_k100"]["queries_per_sec"] == 42.0
+    assert by["unordered_1dev_k8"]["queries_per_sec"] == 1.0
+    assert rep["full"] is True  # both runs --full: flag stays trustworthy
+
+
+def test_only_failed_rerun_keeps_prior_good_row(suite, monkeypatch):
+    prior = {"full": True, "tpu_available": False, "results": [
+        {"config": "unordered_1dev_k100", "queries_per_sec": 7.0},
+    ]}
+    with open(suite.REPORT_PATH, "w") as f:
+        json.dump(prior, f)
+
+    monkeypatch.setattr(suite.subprocess, "run", _fake_run(lambda s: None))
+    monkeypatch.setattr(sys, "argv",
+                        ["benchmarks.py", "--full", "--only", "k100"])
+    assert suite.main() == 0
+    rep = json.load(open(suite.REPORT_PATH))
+    row = [r for r in rep["results"]
+           if r["config"] == "unordered_1dev_k100"][0]
+    assert row.get("queries_per_sec") == 7.0  # crash did not clobber
+
+
+def test_only_mode_disagreement_nulls_full_flag(suite, monkeypatch):
+    prior = {"full": True, "tpu_available": False, "results": [
+        {"config": "unordered_1dev_k8", "queries_per_sec": 1.0},
+    ]}
+    with open(suite.REPORT_PATH, "w") as f:
+        json.dump(prior, f)
+    monkeypatch.setattr(
+        suite.subprocess, "run",
+        _fake_run(lambda s: {"config": s["name"], "queries_per_sec": 3.0}))
+    # quick-mode re-run into a full report
+    monkeypatch.setattr(sys, "argv", ["benchmarks.py", "--only", "k8"])
+    assert suite.main() == 0
+    rep = json.load(open(suite.REPORT_PATH))
+    assert rep["full"] is None
+
+
+def test_only_usage_errors(suite, monkeypatch):
+    monkeypatch.setattr(sys, "argv", ["benchmarks.py", "--only"])
+    assert suite.main() == 2
+    monkeypatch.setattr(sys, "argv", ["benchmarks.py", "--only", "--full"])
+    assert suite.main() == 2
+    monkeypatch.setattr(sys, "argv", ["benchmarks.py", "--only", "nomatch"])
+    assert suite.main() == 2

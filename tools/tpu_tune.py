@@ -34,6 +34,12 @@ from mpi_cuda_largescaleknn_tpu.utils.compile_cache import (  # noqa: E402
 # Children inherit the env: repeated-geometry cells skip XLA compile.
 enable_persistent_cache()
 
+# report lives at the repo root regardless of invocation cwd (the --cells
+# merge must find the checkpointed report it protects)
+REPORT_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tpu_tune_report.json")
+
 _CHILD = r"""
 import json, sys, time
 import numpy as np
@@ -149,7 +155,7 @@ def _run_cell(spec, results):
     else:
         results.append(json.loads(line[len("RESULT "):]))
         print(json.dumps(results[-1]), flush=True)
-    with open("tpu_tune_report.json", "w") as f:
+    with open(REPORT_PATH, "w") as f:
         json.dump(results, f, indent=1)
 
 
@@ -176,15 +182,24 @@ def main() -> int:
                 sort_keys=True)
 
         rerun = {_key(s) for s in specs}
+        prior_rows = {}
         try:
-            with open("tpu_tune_report.json") as f:
-                # drop stale rows being re-measured (and old error rows)
-                results = [r for r in json.load(f)
-                           if "qps" in r and _key(r) not in rerun]
+            with open(REPORT_PATH) as f:
+                loaded = [r for r in json.load(f) if "qps" in r]
+            # stale rows being re-measured leave the live list, but stay
+            # at hand: a failed re-run must NOT delete a checkpointed
+            # measurement an outage makes unrepeatable
+            prior_rows = {_key(r): r for r in loaded}
+            results = [r for r in loaded if _key(r) not in rerun]
         except (OSError, ValueError):
             results = []
         for spec in specs:
+            n_before = len(results)
             _run_cell(spec, results)
+            if len(results) == n_before and _key(spec) in prior_rows:
+                results.append(prior_rows[_key(spec)])
+                with open(REPORT_PATH, "w") as f:
+                    json.dump(results, f, indent=1)
         return 0
     results = []
     for spec in _cells(quick):
