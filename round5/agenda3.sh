@@ -22,11 +22,17 @@ run_step() { # name timeout_s cmd...
 #    (bench auto-adopts the committed tune winner; expect >550K q/s).
 run_step bench_1m_k8_tuned 1700 env BENCH_BUDGET_S=1500 python bench.py
 
-# 2. Targeted tune cells the outage killed: 1M confirms (k8 winner pair
-#    + k100 winner) and the two missed k=100 sweep cells. The k8-winner
-#    confirm reuses step 1's compile via the cache.
-run_step tune_missed 3600 python -u tools/tpu_tune.py \
-    --cells round5/missed_cells.json
+# 2. Targeted tune cells: the outage-killed 1M confirms + k=100 sweep
+#    cells, PLUS the unswept lanes/point-group neighborhood of the
+#    256/G2 winner (the crossed grid only swept lanes at G1; 4096 beat
+#    2048 by 12% at 128/G1). Generous per-cell cap: a SIGKILLed TPU
+#    child wedged the tunnel at 04:05 (see SKILL.md).
+run_step tune_missed 5400 env TUNE_TIMEOUT_S=900 \
+    python -u tools/tpu_tune.py --cells round5/missed_cells.json
+
+# 2b. Re-bench 1M/k=8 with whatever the extended sweep crowned (bench
+#     auto-adopts; compile cached if the winner is a confirmed cell).
+run_step bench_1m_k8_best 1200 env BENCH_BUDGET_S=1000 python bench.py
 
 # 3. k=100 at 1M on chip (VERDICT item 4's real target).
 run_step bench_1m_k100_tuned 2200 env BENCH_K=100 BENCH_BUDGET_S=2000 \
