@@ -58,7 +58,8 @@ def _export_tiled(n, k, bucket_size, group, warm):
 @pytest.mark.parametrize(
     "bucket_size,group,k,warm",
     [
-        (512, 1, 8, True),    # bench default geometry (auto pallas bucket)
+        (256, 2, 8, True),    # auto default: the round-5 tune winner
+        (512, 1, 8, True),    # round-4 default (checkpoint-compat path)
         (64, 8, 8, True),     # the tune sweep's pair-budget geometry
         (64, 8, 100, True),   # k=100: segmented fold (LSK_FOLD_SEGS path)
         (256, 1, 8, False),   # cold heap, no coarsening (probe stage shape)
