@@ -1,0 +1,145 @@
+"""``tpuknn-serve`` — the online serving entry point (no reference analogue).
+
+The batch CLIs answer one self-join and exit; this one loads a point set,
+builds the resident sharded index, AOT-compiles every shape bucket, and
+serves queries over HTTP until killed:
+
+    python -m mpi_cuda_largescaleknn_tpu.cli.serve_main points.float3 -k 100 \
+        [--port 8080] [--engine auto] [--shards R] [--max-batch 1024] \
+        [--max-delay-ms 2] [--max-queue-rows 4096] [--timeout-ms 5000]
+
+Endpoints: POST /knn (JSON or binary), GET /healthz, /stats, /metrics
+(Prometheus text). See docs/SERVING.md and tools/loadgen.py.
+"""
+
+from __future__ import annotations
+
+import math
+import sys
+
+# BEFORE any jax import: the persistent compile cache env vars are read at
+# backend init, and a serving process is exactly the caller that must never
+# repay the ~220s cold compile twice
+from mpi_cuda_largescaleknn_tpu.utils.compile_cache import (
+    enable_persistent_cache,
+)
+
+SERVE_FLAGS = """
+  -k N              neighbors per query (required)
+  -r R              max search radius (default inf)
+  --port P          HTTP port (default 8080; 0 = pick a free port)
+  --host H          bind address (default 127.0.0.1)
+  --engine E        tiled | pallas_tiled | bruteforce | auto (default auto)
+  --shards N        size of the 1-D device mesh (default: all devices)
+  --bucket-size N   points per spatial bucket (0 = engine-tuned auto)
+  --max-batch N     widest padded query batch / shape bucket (default 1024)
+  --min-batch N     narrowest shape bucket (default 8)
+  --max-delay-ms F  micro-batch flush deadline (default 2.0)
+  --max-queue-rows N  admission cap on queued+running rows (default 4096)
+  --timeout-ms F    default per-request deadline (default 5000)
+  --no-warmup       skip compiling all shape buckets before serving
+                    (first request per bucket then pays the compile)
+  --timings         print engine phase timings as JSON on shutdown
+  --verbose         log each HTTP request to stderr
+"""
+
+
+def usage(error: str) -> "NoReturn":  # noqa: F821
+    sys.stderr.write(f"Error: {error}\n\n")
+    sys.stderr.write(f"tpuknn-serve -k <k> [options] <input>\n{SERVE_FLAGS}")
+    sys.exit(1)
+
+
+def parse_serve_args(argv: list[str]) -> dict:
+    opt = {"k": 0, "max_radius": math.inf, "in_path": "", "port": 8080,
+           "host": "127.0.0.1", "engine": "auto", "shards": None,
+           "bucket_size": 0, "max_batch": 1024, "min_batch": 8,
+           "max_delay_ms": 2.0, "max_queue_rows": 4096,
+           "timeout_ms": 5000.0, "warmup": True, "timings": False,
+           "verbose": False}
+    i = 0
+    try:
+        while i < len(argv):
+            arg = argv[i]
+            if not arg.startswith("-"):
+                opt["in_path"] = arg
+            elif arg == "-k":
+                i += 1; opt["k"] = int(argv[i])
+            elif arg == "-r":
+                i += 1; opt["max_radius"] = float(argv[i])
+            elif arg == "--port":
+                i += 1; opt["port"] = int(argv[i])
+            elif arg == "--host":
+                i += 1; opt["host"] = argv[i]
+            elif arg == "--engine":
+                i += 1; opt["engine"] = argv[i]
+            elif arg == "--shards":
+                i += 1; opt["shards"] = int(argv[i])
+            elif arg == "--bucket-size":
+                i += 1; opt["bucket_size"] = int(argv[i])
+            elif arg == "--max-batch":
+                i += 1; opt["max_batch"] = int(argv[i])
+            elif arg == "--min-batch":
+                i += 1; opt["min_batch"] = int(argv[i])
+            elif arg == "--max-delay-ms":
+                i += 1; opt["max_delay_ms"] = float(argv[i])
+            elif arg == "--max-queue-rows":
+                i += 1; opt["max_queue_rows"] = int(argv[i])
+            elif arg == "--timeout-ms":
+                i += 1; opt["timeout_ms"] = float(argv[i])
+            elif arg == "--no-warmup":
+                opt["warmup"] = False
+            elif arg == "--timings":
+                opt["timings"] = True
+            elif arg == "--verbose":
+                opt["verbose"] = True
+            else:
+                usage(f"unknown cmdline arg '{arg}'")
+            i += 1
+    except (IndexError, ValueError):
+        usage(f"invalid or missing value for '{argv[i - 1] if i else ''}'")
+    if not opt["in_path"]:
+        usage("no input file name specified")
+    if opt["k"] < 1:
+        usage("no k specified, or invalid k value")
+    return opt
+
+
+def main(argv: list[str] | None = None) -> int:
+    opt = parse_serve_args(sys.argv[1:] if argv is None else argv)
+    enable_persistent_cache()
+
+    from mpi_cuda_largescaleknn_tpu.io.reader import read_points
+    from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+    from mpi_cuda_largescaleknn_tpu.serve.engine import ResidentKnnEngine
+    from mpi_cuda_largescaleknn_tpu.serve.server import (
+        build_server,
+        serve_forever,
+    )
+
+    points = read_points(opt["in_path"])
+    print(f"loaded {len(points)} points from {opt['in_path']}")
+    engine = ResidentKnnEngine(
+        points, opt["k"], mesh=get_mesh(opt["shards"]),
+        engine=opt["engine"], bucket_size=opt["bucket_size"],
+        max_radius=opt["max_radius"], max_batch=opt["max_batch"],
+        min_batch=opt["min_batch"])
+    server = build_server(
+        engine, host=opt["host"], port=opt["port"],
+        max_delay_s=opt["max_delay_ms"] / 1e3,
+        max_queue_rows=opt["max_queue_rows"],
+        default_timeout_s=opt["timeout_ms"] / 1e3,
+        verbose=opt["verbose"])
+    try:
+        serve_forever(server, warmup=opt["warmup"])
+    except KeyboardInterrupt:
+        print("shutting down")
+    finally:
+        server.close()
+        if opt["timings"]:
+            sys.stderr.write(engine.timers.dump() + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,17 +1,109 @@
-"""Phase timers + bandwidth counters.
+"""Phase timers + bandwidth counters + latency histograms.
 
 The reference never measures itself (SURVEY.md §5: no timers anywhere, stdout
 progress lines only) — this subsystem is the capability the TPU build adds so
 BASELINE numbers can be produced at all. Wall-clock per phase, optional bytes
-moved (for cross-shard exchange bandwidth), queries/sec derivation.
+moved (for cross-shard exchange bandwidth), queries/sec derivation, and
+log-bucketed latency histograms (p50/p95/p99) shared by ``--timings``, the
+serving layer's ``/metrics`` endpoint, and ``tools/loadgen.py``.
 """
 
 from __future__ import annotations
 
+import bisect
 import contextlib
 import json
+import math
+import threading
 import time
 from dataclasses import dataclass, field
+
+
+# shared histogram geometry — module-level so every histogram (server,
+# loadgen, timers) is mergeable and renders identical /metrics buckets:
+# geometric buckets, ~12% relative resolution, spanning [1 us, 120 s]
+_HIST_FACTOR = 2 ** 0.1665
+_HIST_BOUNDS: list[float] = [
+    1e-6 * _HIST_FACTOR ** i
+    for i in range(int(math.log(120.0 / 1e-6, _HIST_FACTOR)) + 2)
+]
+
+
+class LatencyHistogram:
+    """Log-bucketed latency histogram with bounded memory.
+
+    Buckets are geometric (``_HIST_BOUNDS``: factor ~1.122, ~12% relative
+    resolution, [1 us, 120 s]); an observation beyond the top bound lands in
+    a +inf overflow bucket. Percentiles are read off the cumulative counts and
+    reported as the matched bucket's upper bound, so a quantile is
+    conservative by at most one bucket width. ``record`` is thread-safe
+    (serving handler threads and the loadgen's workers all feed one
+    histogram).
+    """
+
+    _BOUNDS = _HIST_BOUNDS
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counts = [0] * (len(self._BOUNDS) + 1)
+        self.count = 0
+        self.sum_seconds = 0.0
+
+    def record(self, seconds: float) -> None:
+        b = bisect.bisect_left(self._BOUNDS, seconds)
+        with self._lock:
+            self.counts[b] += 1
+            self.count += 1
+            self.sum_seconds += seconds
+
+    def percentile(self, p: float) -> float:
+        """Latency (seconds) at quantile ``p`` in [0, 100]; nan when empty."""
+        if self.count == 0:
+            return float("nan")
+        rank = max(1, math.ceil(self.count * p / 100.0))
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= rank:
+                return (self._BOUNDS[i] if i < len(self._BOUNDS)
+                        else float("inf"))
+        return float("inf")
+
+    def merge(self, other: "LatencyHistogram") -> None:
+        with self._lock:
+            for i, c in enumerate(other.counts):
+                self.counts[i] += c
+            self.count += other.count
+            self.sum_seconds += other.sum_seconds
+
+    def report(self) -> dict:
+        def q(p: float):
+            # None, not nan/inf: the report is a strict-JSON artifact
+            # (loadgen --out joins the BENCH series; /stats is scraped) and
+            # json.dumps would emit the non-standard NaN/Infinity tokens
+            v = self.percentile(p)
+            return round(v, 6) if math.isfinite(v) else None
+
+        return {"count": self.count,
+                "sum_seconds": round(self.sum_seconds, 6),
+                "p50": q(50), "p95": q(95), "p99": q(99)}
+
+    def prometheus_lines(self, name: str) -> list[str]:
+        """Render as a Prometheus-text histogram (cumulative ``le`` buckets).
+
+        Empty buckets are elided (the geometry has ~170 buckets; a scrape
+        only needs the populated prefix sums plus the +Inf terminal)."""
+        lines = [f"# TYPE {name} histogram"]
+        cum = 0
+        for i, c in enumerate(self.counts[:-1]):
+            cum += c
+            if c:
+                lines.append(
+                    f'{name}_bucket{{le="{self._BOUNDS[i]:.6g}"}} {cum}')
+        lines.append(f'{name}_bucket{{le="+Inf"}} {self.count}')
+        lines.append(f"{name}_sum {self.sum_seconds:.6g}")
+        lines.append(f"{name}_count {self.count}")
+        return lines
 
 
 @dataclass
@@ -28,6 +120,7 @@ class PhaseRecord:
 @dataclass
 class PhaseTimers:
     phases: dict[str, PhaseRecord] = field(default_factory=dict)
+    histograms: dict[str, LatencyHistogram] = field(default_factory=dict)
 
     @contextlib.contextmanager
     def phase(self, name: str, bytes_moved: int = 0):
@@ -40,10 +133,24 @@ class PhaseTimers:
             rec.calls += 1
             rec.bytes_moved += bytes_moved
 
+    def hist(self, name: str) -> LatencyHistogram:
+        """Named latency histogram (created on first use); shows up in
+        ``report()`` next to the phases, so ``--timings`` callers and the
+        serving ``/stats`` endpoint share one percentile source."""
+        h = self.histograms.get(name)
+        if h is None:
+            h = self.histograms[name] = LatencyHistogram()
+        return h
+
     def report(self) -> dict:
-        return {name: {"seconds": round(r.seconds, 6), "calls": r.calls,
-                       **({"GB/s": round(r.gb_per_sec, 3)} if r.bytes_moved else {})}
-                for name, r in self.phases.items()}
+        # list() snapshots: a serving /stats scrape may race a worker thread
+        # inserting a new phase or histogram mid-iteration
+        out = {name: {"seconds": round(r.seconds, 6), "calls": r.calls,
+                      **({"GB/s": round(r.gb_per_sec, 3)} if r.bytes_moved else {})}
+               for name, r in list(self.phases.items())}
+        for name, h in list(self.histograms.items()):
+            out[name] = h.report()
+        return out
 
     def dump(self) -> str:
         return json.dumps(self.report())

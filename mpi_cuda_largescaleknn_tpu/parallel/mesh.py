@@ -29,6 +29,10 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from mpi_cuda_largescaleknn_tpu.utils.compat import install as _install_compat
+
+_install_compat()  # older jax: bridge jax.shard_map & co before any engine
+
 AXIS = "shards"  # the single mesh axis name used by the engines
 
 
@@ -123,7 +127,11 @@ def pvary(x):
     constants (e.g. empty candidate heaps) start replicated and must be cast
     before entering a loop whose body mixes them with sharded data.
     Idempotent: leaves already varying along AXIS pass through unchanged.
+    On older jax (no ``lax.pcast``) there is no varying-manual-axes type
+    system to satisfy, so this is the identity (utils/compat.py).
     """
+    if not hasattr(jax.lax, "pcast"):
+        return x
 
     def cast(a):
         vma = getattr(jax.typeof(a), "vma", frozenset())

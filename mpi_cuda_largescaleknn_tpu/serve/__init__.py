@@ -1,0 +1,25 @@
+"""Online kNN serving layer.
+
+The batch pipelines (models/) pay data load + tree build + XLA compile on
+every process launch — ~220s of compile alone at the 250K config
+(utils/compile_cache.py). This subsystem keeps all of it resident and
+amortizes it across a request stream:
+
+- ``engine``   — loads points once, builds the sharded spatial index once,
+                 AOT-compiles one query program per shape bucket (powers of
+                 two up to ``max_batch``) so steady-state traffic can never
+                 recompile.
+- ``batcher``  — dynamic micro-batching: queued queries coalesce into the
+                 smallest covering shape bucket, flushing on max-batch or a
+                 latency deadline, with per-request demux.
+- ``admission``— bounded queue + backpressure (explicit overload errors, not
+                 unbounded growth), per-request deadlines, and graceful
+                 degradation from the Pallas engine to the XLA twin.
+- ``server``   — stdlib-HTTP JSON/binary endpoint: /knn, /healthz, /stats,
+                 Prometheus-text /metrics.
+
+TPU-KNN (arXiv:2206.14286) reaches peak FLOP/s only with large fixed-shape
+query batches; PANDA (arXiv:1607.08220) frames distributed kNN as a
+long-lived service over a partitioned index. This layer is both arguments
+implemented: fixed shapes via bucketing, residency via the process.
+"""

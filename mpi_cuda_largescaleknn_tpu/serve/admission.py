@@ -1,0 +1,121 @@
+"""Admission control: bounded queue, backpressure, graceful degradation.
+
+A serving process that queues without bound converts overload into
+unbounded latency for everyone (and eventually OOM). This layer rejects at
+the door instead: ``AdmissionController`` tracks in-flight rows against a
+hard cap and raises ``OverloadError`` — the HTTP layer maps it to a
+429-style response with Retry-After, so clients shed load and the resident
+engine keeps serving at its max throughput.
+
+``GracefulQueryFn`` wraps the engine with the runtime fallback the ISSUE
+requires: if the Pallas kernel raises at runtime (driver regression, lowering
+bug on a new shape), the engine degrades to the XLA twin — identical results
+by the twin-engine contract — and the failure is recorded in stats rather
+than taking the service down.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class OverloadError(RuntimeError):
+    """Server at capacity — client should retry after ``retry_after_s``."""
+
+    def __init__(self, msg: str, retry_after_s: float = 0.05):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline passed before (or while) it executed."""
+
+
+class AdmissionController:
+    """Row-granular bounded admission.
+
+    Rows, not requests: one 1024-row request costs the engine what 1024
+    singletons do, so the cap must count what the engine pays for.
+    """
+
+    def __init__(self, max_queue_rows: int = 4096,
+                 default_timeout_s: float = 5.0):
+        self.max_queue_rows = int(max_queue_rows)
+        self.default_timeout_s = float(default_timeout_s)
+        self._lock = threading.Lock()
+        self._inflight_rows = 0
+        self.admitted = 0
+        self.rejected = 0
+
+    def admit(self, n_rows: int) -> None:
+        """Reserve ``n_rows`` of queue budget or raise ``OverloadError``.
+        Callers MUST pair with ``release`` (use ``admitted_rows``)."""
+        with self._lock:
+            if self._inflight_rows + n_rows > self.max_queue_rows:
+                self.rejected += 1
+                raise OverloadError(
+                    f"queue full ({self._inflight_rows}/"
+                    f"{self.max_queue_rows} rows in flight)")
+            self._inflight_rows += n_rows
+            self.admitted += 1
+
+    def release(self, n_rows: int) -> None:
+        with self._lock:
+            self._inflight_rows -= n_rows
+
+    def admitted_rows(self, n_rows: int):
+        """Context manager form of admit/release."""
+        return _Admitted(self, n_rows)
+
+    def inflight_rows(self) -> int:
+        with self._lock:
+            return self._inflight_rows
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"inflight_rows": self._inflight_rows,
+                    "max_queue_rows": self.max_queue_rows,
+                    "admitted": self.admitted,
+                    "rejected": self.rejected}
+
+
+class _Admitted:
+    def __init__(self, ctrl: AdmissionController, n_rows: int):
+        self._ctrl = ctrl
+        self._n = n_rows
+
+    def __enter__(self):
+        self._ctrl.admit(self._n)
+        return self
+
+    def __exit__(self, *exc):
+        self._ctrl.release(self._n)
+        return False
+
+
+class GracefulQueryFn:
+    """Engine call with one-shot degradation to the XLA twin.
+
+    On the first non-overload exception from a degradable engine
+    (``pallas_tiled``), swap to ``tiled`` and retry the same batch once.
+    The twin compiles per shape bucket on first use after degradation
+    (counted in ``compile_count`` like any compile); results are identical
+    by the twin-engine contract, so callers never observe the swap except
+    through stats.
+    """
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._lock = threading.Lock()
+        self.failures = 0
+
+    def __call__(self, queries):
+        try:
+            return self.engine.query(queries)
+        except Exception as e:  # noqa: BLE001 - re-raised unless degradable
+            with self._lock:
+                self.failures += 1
+                if not self.engine.can_degrade():
+                    raise
+                self.engine.degrade(f"{type(e).__name__}: {e}")
+            return self.engine.query(queries)

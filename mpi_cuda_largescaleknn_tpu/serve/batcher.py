@@ -1,0 +1,196 @@
+"""Dynamic micro-batching: coalesce a request stream into engine batches.
+
+TPU-KNN's throughput argument (arXiv:2206.14286) cuts against serving one
+query at a time: the engine's fixed-shape programs want the widest batch the
+latency budget allows. This batcher sits between N concurrent callers and
+the single-threaded engine: requests queue; the worker flushes when the
+queued rows reach ``max_batch`` OR the oldest request has waited
+``max_delay_s`` — the classic throughput/latency dial. A flush concatenates
+whole requests (never splitting one across engine calls keeps demux
+trivial), pads to the smallest covering shape bucket inside the engine, and
+demuxes per-request slices back to each caller.
+
+Deadlines: a request whose deadline passed while queued is completed with
+``DeadlineExceeded`` instead of burning engine time on an answer nobody is
+waiting for.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from mpi_cuda_largescaleknn_tpu.serve.admission import DeadlineExceeded
+
+
+@dataclass
+class _Request:
+    queries: np.ndarray
+    deadline: float | None
+    enqueued: float
+    done: threading.Event = field(default_factory=threading.Event)
+    result: tuple | None = None
+    error: Exception | None = None
+
+    @property
+    def rows(self) -> int:
+        return len(self.queries)
+
+
+class DynamicBatcher:
+    """Single worker thread draining a request queue through ``query_fn``.
+
+    ``query_fn(queries f32[n,3]) -> (dists f32[n], neighbors i32[n,k])`` —
+    typically ``admission.GracefulQueryFn`` wrapping a ResidentKnnEngine.
+    """
+
+    def __init__(self, query_fn, *, max_batch: int,
+                 max_delay_s: float = 0.002, timers=None):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self._query_fn = query_fn
+        self.max_batch = int(max_batch)
+        self.max_delay_s = float(max_delay_s)
+        self._timers = timers
+        self._cond = threading.Condition()
+        self._queue: deque[_Request] = deque()
+        self._queued_rows = 0
+        self._shutdown = False
+        # counters (under _cond)
+        self.batches = 0
+        self.rows_served = 0
+        self.rows_expired = 0
+        self.flush_full = 0
+        self.flush_deadline = 0
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name="knn-batcher")
+        self._worker.start()
+
+    # ------------------------------------------------------------------ submit
+
+    def submit(self, queries: np.ndarray, timeout_s: float | None = None):
+        """Block until the batch containing ``queries`` executes; returns
+        ``(dists, neighbors)`` or raises the request's error."""
+        queries = np.asarray(queries, np.float32).reshape(-1, 3)
+        now = time.monotonic()
+        req = _Request(queries=queries, enqueued=now,
+                       deadline=(now + timeout_s) if timeout_s else None)
+        with self._cond:
+            if self._shutdown:
+                raise RuntimeError("batcher is shut down")
+            self._queue.append(req)
+            self._queued_rows += req.rows
+            self._cond.notify_all()
+        # grace beyond the deadline: the worker completes expired requests
+        # with DeadlineExceeded itself; the extra wait covers an in-flight
+        # engine call that started before the deadline passed
+        wait = None if timeout_s is None else timeout_s + 30.0
+        if not req.done.wait(wait):
+            raise DeadlineExceeded("request stuck in batcher")
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    # ------------------------------------------------------------------ worker
+
+    def _take_batch(self) -> list[_Request] | None:
+        """Wait for a flushable batch; None on shutdown."""
+        with self._cond:
+            while True:
+                if self._shutdown and not self._queue:
+                    return None
+                if self._queue:
+                    oldest = self._queue[0]
+                    flush_at = oldest.enqueued + self.max_delay_s
+                    now = time.monotonic()
+                    if (self._queued_rows >= self.max_batch
+                            or now >= flush_at or self._shutdown):
+                        break
+                    self._cond.wait(flush_at - now)
+                else:
+                    self._cond.wait()
+            # pop whole requests while they fit; a single over-wide request
+            # (> max_batch rows) was rejected upstream by admission sizing,
+            # but guard anyway by always taking at least one
+            batch = [self._queue.popleft()]
+            rows = batch[0].rows
+            while self._queue and rows + self._queue[0].rows <= self.max_batch:
+                r = self._queue.popleft()
+                batch.append(r)
+                rows += r.rows
+            self._queued_rows -= rows
+            self.batches += 1
+            if rows >= self.max_batch:
+                self.flush_full += 1
+            else:
+                self.flush_deadline += 1
+            return batch
+
+    def _run(self):
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            now = time.monotonic()
+            live, expired = [], []
+            for r in batch:
+                (expired if (r.deadline is not None and now > r.deadline)
+                 else live).append(r)
+            for r in expired:
+                with self._cond:
+                    self.rows_expired += r.rows
+                r.error = DeadlineExceeded(
+                    f"deadline passed after {now - r.enqueued:.3f}s in queue")
+                r.done.set()
+            if not live:
+                continue
+            try:
+                t0 = time.perf_counter()
+                merged = (live[0].queries if len(live) == 1 else
+                          np.concatenate([r.queries for r in live]))
+                dists, nbrs = self._query_fn(merged)
+                if self._timers is not None:
+                    self._timers.hist("batch_exec_seconds").record(
+                        time.perf_counter() - t0)
+                off = 0
+                for r in live:
+                    r.result = (dists[off:off + r.rows],
+                                nbrs[off:off + r.rows])
+                    off += r.rows
+                    r.done.set()
+                with self._cond:
+                    self.rows_served += len(merged)
+            except Exception as e:  # noqa: BLE001 - delivered per request
+                for r in live:
+                    r.error = e
+                    r.done.set()
+
+    # ------------------------------------------------------------------- admin
+
+    def queue_depth_rows(self) -> int:
+        with self._cond:
+            return self._queued_rows
+
+    def stats(self) -> dict:
+        with self._cond:
+            return {
+                "batches": self.batches,
+                "rows_served": self.rows_served,
+                "rows_expired": self.rows_expired,
+                "flush_full": self.flush_full,
+                "flush_deadline": self.flush_deadline,
+                "queue_rows": self._queued_rows,
+                "mean_batch_rows": round(
+                    self.rows_served / self.batches, 2) if self.batches else 0,
+            }
+
+    def shutdown(self, wait: bool = True):
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+        if wait:
+            self._worker.join(timeout=10)

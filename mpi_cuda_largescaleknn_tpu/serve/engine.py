@@ -1,0 +1,316 @@
+"""Resident query engine: the index lives on the mesh, queries fly through.
+
+Build once: the point set is slab-sharded over the 1-D device mesh and
+median-split into spatial buckets per shard (the same partition the ring
+drivers hoist out of their jits — ``partition_sharded``). Query forever: an
+incoming batch is padded to its shape bucket, replicated to every device,
+traversed against each device's resident buckets (the exact nearest-first
+prune of ops/tiled.py — each shard returns its local top-k), and the
+R-way partial candidates are merged on the host.
+
+Shape discipline is the whole point (TPU-KNN, arXiv:2206.14286: peak
+throughput needs large *fixed* shapes): query programs are AOT-compiled
+(``jit(...).lower(...).compile()``) per power-of-two batch bucket, so a
+served shape can NEVER silently retrace — an unexpected shape raises, and
+``compile_count`` is an honest counter the recompile-freedom tests assert
+on. ``auto`` resolves to the Pallas kernel on TPU / the XLA twin elsewhere
+(parallel/ring.py resolve_engine); a runtime Pallas failure degrades to the
+twin via ``degrade()`` (driven by serve/admission.py).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+
+import numpy as np
+
+from mpi_cuda_largescaleknn_tpu.core.types import PAD_SENTINEL
+from mpi_cuda_largescaleknn_tpu.models.sharding import (
+    pad_and_flatten,
+    slab_bounds,
+)
+from mpi_cuda_largescaleknn_tpu.obs.timers import PhaseTimers
+from mpi_cuda_largescaleknn_tpu.utils.math import next_pow2
+
+
+class UnservableShapeError(ValueError):
+    """A batch no shape bucket covers reached the engine (the admission
+    layer should have rejected or split it)."""
+
+
+class ResidentKnnEngine:
+    """One resident sharded index + a family of fixed-shape query programs.
+
+    Thread-compatibility: ``query`` is serialized by an internal lock — the
+    micro-batcher is the intended (single) caller, but a direct caller must
+    not corrupt the stats either.
+    """
+
+    def __init__(self, points: np.ndarray, k: int, *, mesh=None,
+                 engine: str = "auto", bucket_size: int = 0,
+                 max_radius: float = math.inf, max_batch: int = 1024,
+                 min_batch: int = 8):
+        import jax
+
+        from mpi_cuda_largescaleknn_tpu.parallel.mesh import AXIS, get_mesh
+        from mpi_cuda_largescaleknn_tpu.parallel.ring import (
+            resolve_bucket_size,
+            resolve_engine,
+        )
+
+        points = np.asarray(points, np.float32)
+        if points.ndim != 2 or points.shape[1] != 3:
+            raise ValueError(f"points must be [N, 3], got {points.shape}")
+        if k < 1:
+            raise ValueError("k must be >= 1")
+        min_batch = max(8, next_pow2(min_batch))
+        max_batch = next_pow2(max_batch)
+        if max_batch < min_batch:
+            raise ValueError(f"max_batch {max_batch} < min_batch {min_batch}")
+
+        self.k = int(k)
+        self.n_points = len(points)
+        self.max_radius = float(max_radius)
+        self.mesh = mesh if mesh is not None else get_mesh(None)
+        self.num_shards = self.mesh.shape[AXIS]
+        self.engine_name = resolve_engine(engine)
+        self.bucket_size = resolve_bucket_size(bucket_size, self.engine_name)
+        #: ascending power-of-two padded batch sizes; all client batch sizes
+        #: in [1, max_batch] round up into one of these
+        self.shape_buckets = [b for b in
+                              (min_batch << i for i in range(64))
+                              if b <= max_batch] or [min_batch]
+        self.max_batch = self.shape_buckets[-1]
+        self.timers = PhaseTimers()
+        self.compile_count = 0
+        self.degraded_reason: str | None = None
+        self._lock = threading.Lock()
+        self._executables: dict = {}   # (engine_name, qpad) -> AOT executable
+
+        with self.timers.phase("index_build"):
+            self._build_index(points, jax)
+
+    # ------------------------------------------------------------------ build
+
+    def _build_index(self, points, jax):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from mpi_cuda_largescaleknn_tpu.parallel.mesh import AXIS
+        from mpi_cuda_largescaleknn_tpu.parallel.ring import partition_sharded
+
+        bounds = slab_bounds(len(points), self.num_shards)
+        shards = [points[b:e] for b, e in bounds]
+        flat, ids, _counts, self.npad_local = pad_and_flatten(
+            shards, id_bases=[b for b, _ in bounds])
+        sharding = NamedSharding(self.mesh, P(AXIS))
+        # the flat resident side serves the bruteforce engine; the bucketed
+        # one serves the tiled engines — both stay device-resident for the
+        # life of the process (the reference re-uploads per launch)
+        self._flat_pts = jax.device_put(flat, sharding)
+        self._flat_ids = jax.device_put(ids, sharding)
+        self._buckets = partition_sharded(self._flat_pts, self._flat_ids,
+                                          self.mesh, self.bucket_size)
+        self._replicated = NamedSharding(self.mesh, P())
+
+    # ------------------------------------------------------------- compilation
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest shape bucket covering an ``n``-query batch."""
+        for b in self.shape_buckets:
+            if b >= n:
+                return b
+        raise UnservableShapeError(
+            f"batch of {n} queries exceeds max_batch {self.max_batch}")
+
+    def _build_query_fn(self, engine_name: str, qpad: int):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from mpi_cuda_largescaleknn_tpu.ops.brute_force import (
+            knn_update_bruteforce,
+        )
+        from mpi_cuda_largescaleknn_tpu.ops.candidates import init_candidates
+        from mpi_cuda_largescaleknn_tpu.ops.partition import BucketedPoints
+        from mpi_cuda_largescaleknn_tpu.parallel.mesh import AXIS, pvary
+        from mpi_cuda_largescaleknn_tpu.parallel.ring import _tiled_engine_fn
+
+        k, max_radius = self.k, self.max_radius
+        use_tiled = engine_name in ("tiled", "pallas_tiled")
+
+        if use_tiled:
+            tiled_update = _tiled_engine_fn(engine_name)
+
+            def body(bpts, bids, blo, bhi, q):
+                # q f32[qpad,3] is REPLICATED: every device traverses its own
+                # resident shard for the same queries; its local top-k is
+                # exact over that shard, and the host merge of the R partial
+                # candidate rows is exact over the union (the ring's
+                # merge-across-rounds argument, with space instead of time)
+                valid = q[:, 0] < PAD_SENTINEL / 2
+                qids = jnp.where(valid, jnp.arange(qpad, dtype=jnp.int32), -1)
+                lo = jnp.min(jnp.where(valid[:, None], q, jnp.inf), axis=0)
+                hi = jnp.max(jnp.where(valid[:, None], q, -jnp.inf), axis=0)
+                qb = BucketedPoints(q[None], qids[None], lo[None], hi[None],
+                                    qids[None])
+                heap = pvary(init_candidates(qpad, k, max_radius))
+                resident = BucketedPoints(bpts, bids, blo, bhi, bids)
+                st = tiled_update(heap, qb, resident)
+                return st.dist2, st.idx
+
+            in_specs = (P(AXIS),) * 4 + (P(),)
+        else:
+
+            def body(spts, sids, q):
+                heap = pvary(init_candidates(qpad, k, max_radius))
+                st = knn_update_bruteforce(heap, q, spts, sids)
+                return st.dist2, st.idx
+
+            in_specs = (P(AXIS),) * 2 + (P(),)
+
+        check_vma = not engine_name.startswith("pallas")
+        return jax.jit(jax.shard_map(
+            body, mesh=self.mesh, in_specs=in_specs,
+            out_specs=(P(AXIS), P(AXIS)), check_vma=check_vma))
+
+    def _resident_args(self, engine_name: str):
+        if engine_name in ("tiled", "pallas_tiled"):
+            b = self._buckets
+            return (b.pts, b.ids, b.lower, b.upper)
+        return (self._flat_pts, self._flat_ids)
+
+    def _get_executable(self, qpad: int):
+        """AOT executable for (active engine, qpad); compiles on miss.
+
+        ``compile_count`` increments EXACTLY when XLA is invoked — the
+        recompile-freedom contract the tests assert. A compiled executable
+        rejects any other input shape instead of silently retracing.
+        """
+        import jax
+
+        key = (self.engine_name, qpad)
+        exe = self._executables.get(key)
+        if exe is not None:
+            return exe
+        with self.timers.phase(f"compile_q{qpad}"):
+            fn = self._build_query_fn(self.engine_name, qpad)
+            q0 = jax.device_put(
+                np.full((qpad, 3), PAD_SENTINEL, np.float32),
+                self._replicated)
+            exe = fn.lower(*self._resident_args(self.engine_name),
+                           q0).compile()
+            self.compile_count += 1
+        self._executables[key] = exe
+        return exe
+
+    def warmup(self) -> dict:
+        """Compile (and once execute) every shape bucket. Returns per-bucket
+        wall-clock seconds, so the serving CLI can report what a cold start
+        cost — after this, steady-state traffic never compiles."""
+        import jax
+
+        out = {}
+        with self._lock:
+            for qpad in self.shape_buckets:
+                t0 = time.perf_counter()
+                exe = self._get_executable(qpad)
+                # run once on an all-padding batch: pays any lazy backend
+                # init; the traversal early-exits (no real queries)
+                q0 = jax.device_put(
+                    np.full((qpad, 3), PAD_SENTINEL, np.float32),
+                    self._replicated)
+                jax.block_until_ready(
+                    exe(*self._resident_args(self.engine_name), q0))
+                out[qpad] = round(time.perf_counter() - t0, 3)
+        return out
+
+    # ----------------------------------------------------------------- degrade
+
+    def can_degrade(self) -> bool:
+        return self.engine_name == "pallas_tiled"
+
+    def degrade(self, reason: str) -> None:
+        """Swap the Pallas traversal for its XLA twin after a runtime
+        failure (identical results by the twin-engine contract — see
+        tests/test_pallas_tiled.py). Compiled twin programs are cached under
+        their own key, so repeated degradations never recompile."""
+        if not self.can_degrade():
+            raise RuntimeError(
+                f"engine '{self.engine_name}' has no fallback")
+        self.degraded_reason = reason
+        self.engine_name = "tiled"
+        # the twin may want a different tuned bucket geometry, but the index
+        # is already partitioned — keep the resident geometry, stay exact
+
+    # ------------------------------------------------------------------- query
+
+    def query(self, queries: np.ndarray):
+        """f32[n,3] -> (f32[n] k-th-NN distances, i32[n,k] neighbor ids).
+
+        ``n`` may be anything in [0, max_batch]; the batch is padded to its
+        shape bucket. Larger batches are the batcher's/admission's job to
+        split. Distances follow the reference contract: sqrt of the k-th
+        smallest squared distance, inf (or the ``-r`` radius) when fewer
+        than k neighbors exist. Neighbor ids are global point indices,
+        ascending by distance, -1 for unfilled slots.
+        """
+        import jax
+
+        queries = np.asarray(queries, np.float32).reshape(-1, 3)
+        n = len(queries)
+        if n == 0:
+            return (np.zeros(0, np.float32),
+                    np.zeros((0, self.k), np.int32))
+        qpad = self.bucket_for(n)
+
+        with self._lock:
+            exe = self._get_executable(qpad)
+            q = np.full((qpad, 3), PAD_SENTINEL, np.float32)
+            q[:n] = queries
+            t0 = time.perf_counter()
+            q_dev = jax.device_put(q, self._replicated)
+            d2, idx = exe(*self._resident_args(self.engine_name), q_dev)
+            d2 = np.asarray(d2)
+            idx = np.asarray(idx)
+            self.timers.hist("engine_batch_seconds").record(
+                time.perf_counter() - t0)
+
+        with self.timers.phase("host_merge"):
+            dists, nbrs = _merge_shard_candidates(
+                d2, idx, self.num_shards, qpad, self.k)
+        return dists[:n], nbrs[:n]
+
+    def stats(self) -> dict:
+        # list() snapshots _executables atomically: a scrape may race a
+        # compile on the query path (--no-warmup, post-degrade), and bare
+        # dict iteration would raise "changed size during iteration"
+        return {
+            "engine": self.engine_name,
+            "degraded_reason": self.degraded_reason,
+            "n_points": self.n_points,
+            "k": self.k,
+            "num_shards": self.num_shards,
+            "bucket_size": self.bucket_size,
+            "shape_buckets": list(self.shape_buckets),
+            "compiled_shapes": sorted(q for _, q in list(self._executables)),
+            "compile_count": self.compile_count,
+            "timers": self.timers.report(),
+        }
+
+
+def _merge_shard_candidates(d2, idx, num_shards, qpad, k):
+    """Merge R per-shard top-k candidate blocks into the global top-k.
+
+    ``d2``/``idx`` are [R*qpad, k] shard-major. Stable ascending sort by
+    dist2 with shards concatenated in rank order reproduces the engines'
+    merge tie discipline (earlier source wins at equal distance —
+    ops/candidates.py merge_candidates).
+    """
+    d2 = d2.reshape(num_shards, qpad, k).transpose(1, 0, 2).reshape(qpad, -1)
+    idx = idx.reshape(num_shards, qpad, k).transpose(1, 0, 2).reshape(qpad, -1)
+    order = np.argsort(d2, axis=1, kind="stable")[:, :k]
+    top_d2 = np.take_along_axis(d2, order, axis=1)
+    top_idx = np.take_along_axis(idx, order, axis=1)
+    return np.sqrt(top_d2[:, k - 1]), top_idx

@@ -1,0 +1,263 @@
+"""Stdlib-HTTP serving front end: /knn, /healthz, /stats, /metrics.
+
+No web framework (the container bakes no deps beyond the jax toolchain):
+``http.server.ThreadingHTTPServer`` with one handler thread per connection.
+Handler threads only parse, admit, and block on the batcher's demux event —
+all engine work happens on the batcher's single worker thread, so JAX
+dispatch stays single-threaded no matter how many clients connect.
+
+Request formats on POST /knn:
+- JSON  (default): ``{"queries": [[x,y,z], ...], "neighbors": true?,
+  "timeout_ms": 250?}`` -> ``{"dists": [...], "neighbors": [[...], ...]?}``
+- binary (Content-Type: application/octet-stream): little-endian f32
+  x,y,z triples; response is raw f32 distances. Options ride the query
+  string (``/knn?neighbors=1&timeout_ms=250`` — neighbors only in JSON).
+
+Error mapping: queue full -> 429 + Retry-After (admission backpressure),
+deadline -> 504, batch wider than max_batch -> 413, bad input -> 400.
+/metrics is Prometheus text fed by obs/timers.py's LatencyHistogram.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from mpi_cuda_largescaleknn_tpu.obs.timers import LatencyHistogram
+from mpi_cuda_largescaleknn_tpu.serve.admission import (
+    AdmissionController,
+    DeadlineExceeded,
+    GracefulQueryFn,
+    OverloadError,
+)
+from mpi_cuda_largescaleknn_tpu.serve.batcher import DynamicBatcher
+from mpi_cuda_largescaleknn_tpu.serve.engine import UnservableShapeError
+
+
+class ServingMetrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters = {"knn_requests_total": 0, "knn_rows_total": 0,
+                         "knn_overload_total": 0, "knn_deadline_total": 0,
+                         "knn_badrequest_total": 0, "knn_error_total": 0}
+        self.latency = LatencyHistogram()
+
+    def inc(self, name: str, by: int = 1):
+        with self._lock:
+            self.counters[name] += by
+
+
+class KnnServer(ThreadingHTTPServer):
+    daemon_threads = True
+
+    def __init__(self, addr, engine, *, max_delay_s=0.002,
+                 max_queue_rows=4096, default_timeout_s=5.0, query_fn=None,
+                 verbose=False):
+        self.engine = engine
+        self.admission = AdmissionController(
+            max_queue_rows=max_queue_rows,
+            default_timeout_s=default_timeout_s)
+        self.graceful = (GracefulQueryFn(engine) if query_fn is None
+                         else query_fn)
+        self.batcher = DynamicBatcher(self.graceful,
+                                      max_batch=engine.max_batch,
+                                      max_delay_s=max_delay_s,
+                                      timers=engine.timers)
+        self.metrics = ServingMetrics()
+        self.ready = False
+        self.verbose = verbose
+        self._loop_entered = False
+        super().__init__(addr, _Handler)
+
+    def serve_forever(self, poll_interval=0.5):
+        self._loop_entered = True
+        super().serve_forever(poll_interval)
+
+    def close(self):
+        self.batcher.shutdown()
+        # BaseServer.shutdown() waits on an event only serve_forever() sets —
+        # calling it when the loop was never entered (warmup failed, Ctrl-C
+        # during compile) would hang forever instead of exiting
+        if self._loop_entered:
+            self.shutdown()
+        self.server_close()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    # ------------------------------------------------------------------ plumbing
+    def log_message(self, fmt, *args):
+        if self.server.verbose:
+            super().log_message(fmt, *args)
+
+    def _send(self, code: int, body: bytes, ctype: str, extra=()):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in extra:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, obj, extra=()):
+        self._send(code, json.dumps(obj).encode(), "application/json", extra)
+
+    # ------------------------------------------------------------------ GET
+    def do_GET(self):
+        srv: KnnServer = self.server
+        path = urlparse(self.path).path
+        if path == "/healthz":
+            if srv.ready:
+                self._send_json(200, {"status": "ok",
+                                      "engine": srv.engine.engine_name})
+            else:
+                self._send_json(503, {"status": "warming"})
+        elif path == "/stats":
+            self._send_json(200, {
+                "engine": srv.engine.stats(),
+                "batcher": srv.batcher.stats(),
+                "admission": srv.admission.stats(),
+                "server": dict(srv.metrics.counters,
+                               request_latency=srv.metrics.latency.report()),
+            })
+        elif path == "/metrics":
+            self._send(200, self._prometheus(srv).encode(),
+                       "text/plain; version=0.0.4")
+        else:
+            self._send_json(404, {"error": f"no such path {path}"})
+
+    @staticmethod
+    def _prometheus(srv: KnnServer) -> str:
+        e, b, a = srv.engine.stats(), srv.batcher.stats(), srv.admission.stats()
+        lines = []
+        for name, val in srv.metrics.counters.items():
+            lines += [f"# TYPE {name} counter", f"{name} {val}"]
+        gauges = {
+            "knn_ready": int(srv.ready),
+            "knn_engine_degraded": int(e["degraded_reason"] is not None),
+            "knn_compile_count": e["compile_count"],
+            "knn_index_points": e["n_points"],
+            "knn_num_shards": e["num_shards"],
+            "knn_queue_rows": b["queue_rows"],
+            "knn_inflight_rows": a["inflight_rows"],
+            "knn_admission_rejected_total": a["rejected"],
+            "knn_batches_total": b["batches"],
+            "knn_batch_rows_served_total": b["rows_served"],
+        }
+        for name, val in gauges.items():
+            lines += [f"# TYPE {name} gauge", f"{name} {val}"]
+        lines += srv.metrics.latency.prometheus_lines(
+            "knn_request_latency_seconds")
+        hist = srv.engine.timers.histograms.get("engine_batch_seconds")
+        if hist is not None:
+            lines += hist.prometheus_lines("knn_engine_batch_seconds")
+        return "\n".join(lines) + "\n"
+
+    # ------------------------------------------------------------------ POST
+    def _parse_body(self):
+        """-> (queries f32[n,3], want_neighbors, timeout_s, binary)."""
+        qs = parse_qs(urlparse(self.path).query)
+        length = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(length)
+        ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
+        timeout_ms = float(qs.get("timeout_ms", [0])[0] or 0)
+        neighbors = qs.get("neighbors", ["0"])[0] not in ("0", "", "false")
+        if ctype == "application/octet-stream":
+            if len(raw) % 12:
+                raise ValueError("binary body must be n*12 bytes (f32 xyz)")
+            q = np.frombuffer(raw, "<f4").reshape(-1, 3)
+            return q, neighbors, timeout_ms / 1e3, True
+        obj = json.loads(raw.decode() or "{}")
+        q = np.asarray(obj.get("queries", []), np.float32)
+        if q.size == 0:
+            q = q.reshape(0, 3)
+        if q.ndim != 2 or q.shape[1] != 3:
+            raise ValueError(f"queries must be [n, 3], got {list(q.shape)}")
+        if not np.all(np.isfinite(q)):
+            raise ValueError("queries must be finite")
+        timeout_ms = float(obj.get("timeout_ms", timeout_ms) or 0)
+        return q, bool(obj.get("neighbors", neighbors)), timeout_ms / 1e3, False
+
+    def do_POST(self):
+        srv: KnnServer = self.server
+        if urlparse(self.path).path != "/knn":
+            self._send_json(404, {"error": "POST /knn only"})
+            return
+        srv.metrics.inc("knn_requests_total")
+        t0 = time.perf_counter()
+        try:
+            q, want_nbrs, timeout_s, binary = self._parse_body()
+        except (ValueError, json.JSONDecodeError) as e:
+            srv.metrics.inc("knn_badrequest_total")
+            self._send_json(400, {"error": str(e)})
+            return
+        timeout_s = timeout_s or srv.admission.default_timeout_s
+        n = len(q)
+        if n > srv.engine.max_batch:
+            srv.metrics.inc("knn_badrequest_total")
+            self._send_json(413, {
+                "error": f"batch of {n} exceeds max_batch "
+                         f"{srv.engine.max_batch}; split the request"})
+            return
+        if n == 0:
+            if binary:
+                self._send(200, b"", "application/octet-stream")
+            else:
+                self._send_json(200, {"dists": []})
+            return
+        try:
+            with srv.admission.admitted_rows(n):
+                dists, nbrs = srv.batcher.submit(q, timeout_s=timeout_s)
+        except OverloadError as e:
+            srv.metrics.inc("knn_overload_total")
+            self._send_json(429, {"error": str(e)},
+                            extra=[("Retry-After", f"{e.retry_after_s:g}")])
+            return
+        except DeadlineExceeded as e:
+            srv.metrics.inc("knn_deadline_total")
+            self._send_json(504, {"error": str(e)})
+            return
+        except UnservableShapeError as e:
+            srv.metrics.inc("knn_badrequest_total")
+            self._send_json(413, {"error": str(e)})
+            return
+        except Exception as e:  # noqa: BLE001 - the service must not die
+            srv.metrics.inc("knn_error_total")
+            self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        srv.metrics.inc("knn_rows_total", n)
+        srv.metrics.latency.record(time.perf_counter() - t0)
+        if binary:
+            self._send(200, np.asarray(dists, "<f4").tobytes(),
+                       "application/octet-stream")
+        else:
+            out = {"dists": np.asarray(dists, np.float64).tolist()}
+            if want_nbrs:
+                out["neighbors"] = np.asarray(nbrs).tolist()
+            self._send_json(200, out)
+
+
+def build_server(engine, host: str = "127.0.0.1", port: int = 8080,
+                 **kwargs) -> KnnServer:
+    """Construct (but do not start) a KnnServer; ``port=0`` picks a free
+    port (``server.server_address[1]`` reports it — how the tests run)."""
+    return KnnServer((host, port), engine, **kwargs)
+
+
+def serve_forever(server: KnnServer, warmup: bool = True) -> None:
+    """Warm every shape bucket, mark ready, and block serving requests."""
+    if warmup:
+        per_bucket = server.engine.warmup()
+        print(f"warmup compiles done: {per_bucket} (seconds per bucket)")
+    server.ready = True
+    host, port = server.server_address[:2]
+    print(f"serving kNN on http://{host}:{port} "
+          f"(engine={server.engine.engine_name}, "
+          f"k={server.engine.k}, n={server.engine.n_points})")
+    server.serve_forever()

@@ -1,0 +1,40 @@
+"""JAX version compatibility shims (install-once, idempotent).
+
+The engines target current JAX (``jax.shard_map`` with varying-manual-axes
+typing, ``jax.lax.pcast``, ``jax.typeof``); container images sometimes pin
+an older jax where ``shard_map`` still lives in ``jax.experimental`` and the
+vma type system does not exist. Rather than littering every call site with
+version checks, ``install()`` bridges the gap at the ``jax`` module level:
+
+- ``jax.shard_map`` -> wraps ``jax.experimental.shard_map.shard_map``,
+  accepting and dropping the ``check_vma`` kwarg. The old ``check_rep``
+  checker is force-disabled: it predates the vma semantics the engines are
+  written against (e.g. freshly-initialized replicated heap constants
+  entering sharded while_loop carries) and rejects valid programs the
+  current checker accepts. The check is diagnostic only — results are
+  unaffected.
+- ``parallel.mesh.pvary`` no-ops when ``jax.lax.pcast`` is absent (there is
+  no varying type to cast to — see its own guard).
+
+Called from ``parallel/mesh.py`` at import, i.e. before any engine can hit
+``jax.shard_map``. Deliberately NOT from the package ``__init__``: importing
+jax there would break ``utils/compile_cache.py``'s must-run-before-jax
+contract for the CLIs.
+"""
+
+from __future__ import annotations
+
+
+def install() -> None:
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kwargs):
+        del check_vma, kwargs  # vma typing absent on this jax; see module doc
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False)
+
+    jax.shard_map = shard_map

@@ -14,6 +14,7 @@ export (e.g. round5/chip_session.sh) always wins.
 from __future__ import annotations
 
 import os
+import sys
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -28,6 +29,10 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str:
     os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
     try:
         os.makedirs(cache_dir, exist_ok=True)
-    except OSError:
-        pass  # unwritable dir: jax warns and runs uncached
+    except OSError as e:
+        # jax runs fine uncached, but silently repaying the ~220s compile on
+        # every launch is an operational failure an operator must hear about
+        sys.stderr.write(
+            f"warning: compile cache dir {cache_dir!r} is not writable "
+            f"({e}); every XLA compile will be repaid each process\n")
     return cache_dir

@@ -55,7 +55,8 @@ def test_idempotent_and_repo_root_resolves(monkeypatch, tmp_path):
     assert os.path.isfile(os.path.join(_REPO_ROOT, "bench.py"))
 
 
-def test_unwritable_dir_does_not_raise(monkeypatch, tmp_path):
+def test_unwritable_dir_warns_but_does_not_raise(monkeypatch, tmp_path,
+                                                 capsys):
     _clear(monkeypatch)
 
     # forced failure, not a chmod'd dir: root (this container's uid)
@@ -65,6 +66,10 @@ def test_unwritable_dir_does_not_raise(monkeypatch, tmp_path):
 
     import mpi_cuda_largescaleknn_tpu.utils.compile_cache as cc
     monkeypatch.setattr(cc.os, "makedirs", _boom)
-    # helper must swallow the OSError (jax itself warns and runs uncached)
+    # helper must swallow the OSError (jax itself runs uncached) but must
+    # TELL the operator: a silent cache loss repays every compile (~220s
+    # on-chip) forever with no visible cause
     got = enable_persistent_cache(str(tmp_path / "cache"))
     assert got == os.environ["JAX_COMPILATION_CACHE_DIR"]
+    err = capsys.readouterr().err
+    assert "compile cache" in err and "not writable" in err

@@ -1,0 +1,499 @@
+"""Serving layer: resident engine, micro-batcher, admission, HTTP, loadgen.
+
+One module-scoped engine (1500 points, 8 CPU devices, 4 shape buckets) backs
+every test here — residency is the subsystem's point, so the tests share the
+index exactly the way production traffic would.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mpi_cuda_largescaleknn_tpu.obs.timers import LatencyHistogram, PhaseTimers
+from mpi_cuda_largescaleknn_tpu.serve.admission import (
+    AdmissionController,
+    DeadlineExceeded,
+    GracefulQueryFn,
+    OverloadError,
+)
+from mpi_cuda_largescaleknn_tpu.serve.batcher import DynamicBatcher
+from mpi_cuda_largescaleknn_tpu.serve.engine import (
+    ResidentKnnEngine,
+    UnservableShapeError,
+)
+from tests.oracle import assert_dist_equal, kth_nn_dist, random_points
+
+K = 8
+N_POINTS = 1500
+
+
+@pytest.fixture(scope="module")
+def index_points():
+    return random_points(N_POINTS, seed=7)
+
+
+@pytest.fixture(scope="module")
+def engine(index_points):
+    from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+
+    eng = ResidentKnnEngine(index_points, K, mesh=get_mesh(8),
+                            engine="tiled", bucket_size=32,
+                            max_batch=128, min_batch=16)
+    eng.warmup()
+    return eng
+
+
+@pytest.fixture(scope="module")
+def server(engine):
+    from mpi_cuda_largescaleknn_tpu.serve.server import build_server
+
+    srv = build_server(engine, port=0, max_delay_s=0.002)
+    srv.ready = True
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.close()
+
+
+def _post(base, payload: dict, timeout=60):
+    req = urllib.request.Request(
+        base + "/knn", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _url(server):
+    return f"http://127.0.0.1:{server.server_address[1]}"
+
+
+class TestResidentEngine:
+    def test_matches_oracle_large_batch(self, engine, index_points):
+        q = random_points(100, seed=99)
+        d, _ = engine.query(q)
+        assert_dist_equal(d, kth_nn_dist(q, index_points, K))
+
+    def test_matches_oracle_single_query(self, engine, index_points):
+        q = random_points(1, seed=3)
+        d, _ = engine.query(q)
+        assert_dist_equal(d, kth_nn_dist(q, index_points, K))
+
+    def test_neighbor_ids_are_true_neighbors(self, engine, index_points):
+        from tests.oracle import pairwise_dist2_np
+
+        q = random_points(40, seed=11)
+        d, nbrs = engine.query(q)
+        full = pairwise_dist2_np(q, index_points)
+        got_d2 = np.sort(full[np.arange(len(q))[:, None], nbrs], axis=1)
+        want_d2 = np.sort(full, axis=1)[:, :K]
+        np.testing.assert_allclose(got_d2, want_d2, rtol=5e-7)
+
+    def test_recompile_freedom_across_client_batch_sizes(self, engine):
+        """The ISSUE's acceptance bar: after warmup, client batches of 1, 3,
+        17 and 100 must all be absorbed by shape bucketing with ZERO new
+        compiles — ``compile_count`` is the engine's compile hook (it
+        increments exactly when an XLA executable is built, and AOT
+        executables cannot silently retrace)."""
+        warm_compiles = engine.compile_count
+        assert warm_compiles == len(engine.shape_buckets)
+        for n in (1, 3, 17, 100):
+            d, nbrs = engine.query(random_points(n, seed=n))
+            assert d.shape == (n,) and nbrs.shape == (n, K)
+        assert engine.compile_count == warm_compiles
+
+    def test_bucket_selection(self, engine):
+        assert engine.shape_buckets == [16, 32, 64, 128]
+        assert engine.bucket_for(1) == 16
+        assert engine.bucket_for(16) == 16
+        assert engine.bucket_for(17) == 32
+        assert engine.bucket_for(128) == 128
+        with pytest.raises(UnservableShapeError):
+            engine.bucket_for(129)
+
+    def test_empty_batch(self, engine):
+        d, nbrs = engine.query(np.zeros((0, 3), np.float32))
+        assert d.shape == (0,) and nbrs.shape == (0, K)
+
+    def test_bruteforce_engine_matches_oracle(self, index_points):
+        from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+
+        eng = ResidentKnnEngine(index_points[:300], 4, mesh=get_mesh(8),
+                                engine="bruteforce", max_batch=16,
+                                min_batch=16)
+        q = random_points(10, seed=21)
+        d, _ = eng.query(q)
+        assert_dist_equal(d, kth_nn_dist(q, index_points[:300], 4))
+
+    def test_max_radius(self, index_points):
+        from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+
+        r = 0.12
+        eng = ResidentKnnEngine(index_points, 6, mesh=get_mesh(8),
+                                engine="tiled", bucket_size=32,
+                                max_radius=r, max_batch=32, min_batch=32)
+        q = random_points(25, seed=33)
+        d, _ = eng.query(q)
+        assert_dist_equal(d, kth_nn_dist(q, index_points, 6, max_radius=r))
+
+
+class TestBatcher:
+    def test_coalesces_and_demuxes(self):
+        seen_batches = []
+
+        def query_fn(q):
+            seen_batches.append(len(q))
+            # identity-ish: dist = x coord, neighbors = row index
+            return q[:, 0].copy(), np.arange(len(q), dtype=np.int32)[:, None]
+
+        b = DynamicBatcher(query_fn, max_batch=64, max_delay_s=0.02)
+        try:
+            qs = [random_points(n, seed=n) for n in (3, 5, 7, 2)]
+            out = [None] * len(qs)
+
+            def call(i):
+                out[i] = b.submit(qs[i], timeout_s=10)
+
+            ths = [threading.Thread(target=call, args=(i,))
+                   for i in range(len(qs))]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+            for i, q in enumerate(qs):
+                np.testing.assert_array_equal(out[i][0], q[:, 0])
+            # the 20ms flush window must have coalesced the 4 concurrent
+            # requests into fewer engine calls
+            assert len(seen_batches) < len(qs)
+            assert sum(seen_batches) == sum(len(q) for q in qs)
+        finally:
+            b.shutdown()
+
+    def test_flushes_on_max_batch(self):
+        def query_fn(q):
+            return q[:, 0].copy(), np.zeros((len(q), 1), np.int32)
+
+        b = DynamicBatcher(query_fn, max_batch=8, max_delay_s=30.0)
+        try:
+            # 8 rows reach max_batch -> flush long before the 30s deadline
+            t0 = time.monotonic()
+            got = [None, None]
+
+            def call(i):
+                got[i] = b.submit(random_points(4, seed=i), timeout_s=10)
+
+            ths = [threading.Thread(target=call, args=(i,)) for i in (0, 1)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+            assert time.monotonic() - t0 < 5.0
+            assert all(g is not None for g in got)
+            assert b.stats()["flush_full"] >= 1
+        finally:
+            b.shutdown()
+
+    def test_deadline_expires_in_queue(self):
+        def slow_fn(q):
+            time.sleep(0.15)
+            return q[:, 0].copy(), np.zeros((len(q), 1), np.int32)
+
+        b = DynamicBatcher(slow_fn, max_batch=4, max_delay_s=0.001)
+        try:
+            # first request occupies the worker ~150ms...
+            t1 = threading.Thread(
+                target=lambda: b.submit(random_points(2, seed=1),
+                                        timeout_s=10))
+            t1.start()
+            time.sleep(0.05)
+            # ...second expires while queued behind it
+            with pytest.raises(DeadlineExceeded):
+                b.submit(random_points(2, seed=2), timeout_s=0.02)
+            t1.join()
+            assert b.stats()["rows_expired"] == 2
+        finally:
+            b.shutdown()
+
+    def test_errors_propagate(self):
+        def bad_fn(q):
+            raise RuntimeError("engine exploded")
+
+        b = DynamicBatcher(bad_fn, max_batch=4, max_delay_s=0.001)
+        try:
+            with pytest.raises(RuntimeError, match="engine exploded"):
+                b.submit(random_points(2, seed=1), timeout_s=5)
+        finally:
+            b.shutdown()
+
+
+class TestAdmission:
+    def test_rejects_beyond_cap(self):
+        a = AdmissionController(max_queue_rows=10)
+        a.admit(8)
+        with pytest.raises(OverloadError):
+            a.admit(3)
+        a.admit(2)  # exactly at cap is fine
+        a.release(8)
+        a.release(2)
+        assert a.inflight_rows() == 0
+        assert a.stats()["rejected"] == 1
+
+    def test_context_manager_releases_on_error(self):
+        a = AdmissionController(max_queue_rows=10)
+        with pytest.raises(ValueError):
+            with a.admitted_rows(10):
+                raise ValueError("boom")
+        assert a.inflight_rows() == 0
+
+    def test_graceful_degradation_to_twin(self):
+        class FakeEngine:
+            def __init__(self):
+                self.engine_name = "pallas_tiled"
+                self.degraded_reason = None
+                self.calls = 0
+
+            def can_degrade(self):
+                return self.engine_name == "pallas_tiled"
+
+            def degrade(self, reason):
+                self.degraded_reason = reason
+                self.engine_name = "tiled"
+
+            def query(self, q):
+                self.calls += 1
+                if self.engine_name == "pallas_tiled":
+                    raise RuntimeError("pallas lowering failed at runtime")
+                return q[:, 0], np.zeros((len(q), 1), np.int32)
+
+        fake = FakeEngine()
+        g = GracefulQueryFn(fake)
+        q = random_points(4, seed=1)
+        d, _ = g(q)  # first call fails in pallas, retries on the twin
+        np.testing.assert_array_equal(d, q[:, 0])
+        assert fake.engine_name == "tiled"
+        assert "pallas lowering failed" in fake.degraded_reason
+        assert g.failures == 1
+        g(q)  # steady state: no more failures
+        assert g.failures == 1
+
+    def test_non_degradable_engine_reraises(self):
+        class FakeEngine:
+            engine_name = "tiled"
+
+            def can_degrade(self):
+                return False
+
+            def query(self, q):
+                raise RuntimeError("no fallback from here")
+
+        with pytest.raises(RuntimeError, match="no fallback"):
+            GracefulQueryFn(FakeEngine())(random_points(2, seed=1))
+
+
+class TestLatencyHistogram:
+    def test_percentiles_within_bucket_resolution(self):
+        h = LatencyHistogram()
+        vals = np.linspace(0.001, 0.100, 1000)
+        for v in vals:
+            h.record(float(v))
+        # log buckets are ~12% wide: a quantile may be conservative by one
+        # bucket, never optimistic by more than the bucket below
+        for p in (50, 95, 99):
+            want = float(np.percentile(vals, p))
+            got = h.percentile(p)
+            assert want / 1.13 <= got <= want * 1.13, (p, want, got)
+
+    def test_report_and_empty(self):
+        h = LatencyHistogram()
+        assert np.isnan(h.percentile(50))
+        h.record(0.01)
+        rep = h.report()
+        assert rep["count"] == 1 and rep["sum_seconds"] > 0
+
+    def test_prometheus_lines_cumulative(self):
+        h = LatencyHistogram()
+        for v in (0.001, 0.01, 0.01, 0.1):
+            h.record(v)
+        lines = h.prometheus_lines("x_seconds")
+        assert lines[0] == "# TYPE x_seconds histogram"
+        assert 'x_seconds_bucket{le="+Inf"} 4' in lines
+        assert "x_seconds_count 4" in lines
+
+    def test_merge(self):
+        a, b = LatencyHistogram(), LatencyHistogram()
+        a.record(0.01)
+        b.record(0.02)
+        a.merge(b)
+        assert a.count == 2
+
+    def test_phase_timers_hist_in_report(self):
+        t = PhaseTimers()
+        t.hist("req_seconds").record(0.005)
+        rep = t.report()
+        assert rep["req_seconds"]["count"] == 1
+        assert "p99" in rep["req_seconds"]
+
+    def test_empty_report_is_strict_json(self):
+        # an empty histogram must not leak NaN into /stats or loadgen --out:
+        # json.dumps(nan) emits a non-standard token strict parsers reject
+        rep = LatencyHistogram().report()
+        assert rep["p50"] is None and rep["p99"] is None
+        json.loads(json.dumps(rep))
+
+
+class TestHTTPServing:
+    def test_healthz(self, server):
+        with urllib.request.urlopen(_url(server) + "/healthz", timeout=10) as r:
+            assert r.status == 200
+            assert json.loads(r.read())["status"] == "ok"
+
+    def test_concurrent_clients_oracle_exact(self, server, index_points):
+        """The ISSUE's end-to-end bar: concurrent clients through the full
+        HTTP -> admission -> batcher -> engine -> demux path get
+        oracle-exact k-th-NN distances."""
+        base = _url(server)
+        results = {}
+
+        def client(i):
+            q = random_points(5 + 3 * i, seed=100 + i)
+            status, resp = _post(base, {"queries": q.tolist(),
+                                        "neighbors": True})
+            results[i] = (q, status, resp)
+
+        ths = [threading.Thread(target=client, args=(i,)) for i in range(6)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        assert len(results) == 6
+        for q, status, resp in results.values():
+            assert status == 200
+            assert_dist_equal(np.asarray(resp["dists"], np.float32),
+                              kth_nn_dist(q, index_points, K))
+            assert len(resp["neighbors"]) == len(q)
+
+    def test_binary_roundtrip(self, server, index_points):
+        q = random_points(9, seed=5)
+        req = urllib.request.Request(
+            _url(server) + "/knn", data=q.astype("<f4").tobytes(),
+            headers={"Content-Type": "application/octet-stream"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            d = np.frombuffer(resp.read(), "<f4")
+        assert_dist_equal(d, kth_nn_dist(q, index_points, K))
+
+    def test_bad_requests(self, server):
+        base = _url(server)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(base, {"queries": [[1.0, 2.0]]})  # wrong width
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(base, {"queries": (np.zeros((200, 3))).tolist()})  # > max
+        assert e.value.code == 413
+
+    def test_stats_and_metrics(self, server):
+        base = _url(server)
+        stats = json.loads(urllib.request.urlopen(
+            base + "/stats", timeout=10).read())
+        assert stats["engine"]["compile_count"] == len(
+            stats["engine"]["shape_buckets"])
+        assert stats["batcher"]["rows_served"] > 0
+        m = urllib.request.urlopen(base + "/metrics", timeout=10).read().decode()
+        assert "# TYPE knn_requests_total counter" in m
+        assert "knn_request_latency_seconds_bucket" in m
+        assert "knn_compile_count" in m
+
+    def test_no_recompiles_from_http_traffic(self, server, engine):
+        """All the HTTP traffic above rode varied client batch sizes; the
+        shape buckets must have absorbed every one of them."""
+        assert engine.compile_count == len(engine.shape_buckets)
+
+    def test_close_without_serve_forever_does_not_hang(self, engine):
+        """Ctrl-C during warmup: close() runs before serve_forever() ever
+        started — BaseServer.shutdown() would wait forever on the loop's
+        event, so close() must skip it."""
+        from mpi_cuda_largescaleknn_tpu.serve.server import build_server
+
+        srv = build_server(engine, port=0)
+        t = threading.Thread(target=srv.close, daemon=True)
+        t.start()
+        t.join(timeout=10)
+        assert not t.is_alive(), "close() hung without serve_forever()"
+
+    def test_binary_zero_rows_gets_binary_response(self, server):
+        req = urllib.request.Request(
+            _url(server) + "/knn", data=b"",
+            headers={"Content-Type": "application/octet-stream"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"] == "application/octet-stream"
+            assert resp.read() == b""
+
+    def test_saturation_sheds_load_then_recovers(self, engine, index_points):
+        """Overload: a slow engine + a 16-row admission cap + 20 concurrent
+        8-row clients => most are rejected with 429 at the door; afterwards
+        the server still answers correctly (stays healthy)."""
+        from mpi_cuda_largescaleknn_tpu.serve.server import build_server
+
+        real = GracefulQueryFn(engine)
+
+        def slow_fn(q):
+            time.sleep(0.08)
+            return real(q)
+
+        srv = build_server(engine, port=0, max_delay_s=0.001,
+                           max_queue_rows=16, query_fn=slow_fn)
+        srv.ready = True
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        base = _url(srv)
+        try:
+            codes = []
+            lock = threading.Lock()
+
+            def client(i):
+                q = random_points(8, seed=i)
+                try:
+                    status, _ = _post(base, {"queries": q.tolist()})
+                except urllib.error.HTTPError as e:
+                    status = e.code
+                with lock:
+                    codes.append(status)
+
+            ths = [threading.Thread(target=client, args=(i,))
+                   for i in range(20)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+            assert codes.count(429) >= 1, codes
+            assert codes.count(200) >= 1, codes
+            # healthy afterwards: correct answers, zero queue
+            q = random_points(4, seed=777)
+            status, resp = _post(base, {"queries": q.tolist()})
+            assert status == 200
+            assert_dist_equal(np.asarray(resp["dists"], np.float32),
+                              kth_nn_dist(q, index_points, K))
+            assert srv.admission.inflight_rows() == 0
+        finally:
+            srv.close()
+
+
+class TestLoadgen:
+    def test_closed_loop_report(self, server):
+        import sys
+
+        sys.path.insert(0, "tools")
+        from loadgen import run_load
+
+        rep = run_load(_url(server), duration_s=1.0, concurrency=3, batch=8,
+                       seed=1)
+        assert rep["mode"] == "closed"
+        assert rep["ok"] > 0 and rep["net_error"] == 0
+        assert rep["qps"] > 0 and rep["rows_per_s"] > 0
+        for key in ("p50_ms", "p95_ms", "p99_ms"):
+            assert rep[key] > 0
+        # the report must be JSON-serializable (it IS the BENCH artifact)
+        json.dumps(rep)

@@ -1,0 +1,170 @@
+#!/usr/bin/env python3
+"""Load generator for the kNN serving layer (stdlib only, importable).
+
+Closed loop (default): N workers fire back-to-back requests — measures the
+server's saturated throughput and the latency it costs. Open loop: requests
+fire on a fixed-rate schedule regardless of completions — measures latency
+at a target offered load, the regime where queueing (and admission's 429
+shedding) actually shows. Both report q/s, rows/s and p50/p95/p99 from the
+same obs/timers.py LatencyHistogram the server exports on /metrics, so
+client-side and server-side percentiles line up bucket-for-bucket.
+
+    python tools/loadgen.py --url http://127.0.0.1:8080 --duration 10 \
+        --concurrency 8 --batch 16 [--qps 500] [--neighbors] [--out rep.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root when run as a file
+
+from mpi_cuda_largescaleknn_tpu.obs.timers import LatencyHistogram  # noqa: E402
+
+
+def _post_batch(url: str, queries: np.ndarray, timeout_s: float,
+                neighbors: bool) -> int:
+    body = json.dumps({"queries": queries.tolist(),
+                       "neighbors": neighbors}).encode()
+    req = urllib.request.Request(
+        url.rstrip("/") + "/knn", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        json.loads(resp.read().decode())
+        return resp.status
+
+
+def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
+             batch: int = 8, qps: float = 0.0, neighbors: bool = False,
+             timeout_s: float = 10.0, seed: int = 0,
+             scale: float = 1.0) -> dict:
+    """Drive the server; returns the JSON-able report (also the test API).
+
+    ``qps > 0`` switches to open loop: the request schedule is fixed at
+    ``qps`` requests/s, spread over the workers; a worker that falls behind
+    skips ahead (lost sends are counted) rather than silently compressing
+    the offered load.
+    """
+    rng = np.random.default_rng(seed)
+    hist = LatencyHistogram()
+    lock = threading.Lock()
+    counts = {"ok": 0, "overload": 0, "deadline": 0, "http_error": 0,
+              "net_error": 0, "rows_ok": 0, "sched_skipped": 0}
+    stop_at = time.monotonic() + duration_s
+
+    def account(status: int, dt: float, rows: int):
+        hist.record(dt)
+        with lock:
+            if status == 200:
+                counts["ok"] += 1
+                counts["rows_ok"] += rows
+            elif status == 429:
+                counts["overload"] += 1
+            elif status == 504:
+                counts["deadline"] += 1
+            else:
+                counts["http_error"] += 1
+
+    def one_request():
+        q = (rng.random((batch, 3)) * scale).astype(np.float32)
+        t0 = time.perf_counter()
+        try:
+            status = _post_batch(url, q, timeout_s, neighbors)
+            account(status, time.perf_counter() - t0, batch)
+        except urllib.error.HTTPError as e:
+            account(e.code, time.perf_counter() - t0, 0)
+        except Exception:  # noqa: BLE001 - connection refused/reset, timeout
+            with lock:
+                counts["net_error"] += 1
+
+    def closed_worker():
+        while time.monotonic() < stop_at:
+            one_request()
+
+    def open_worker(wid: int):
+        # worker wid owns schedule slots wid, wid+W, wid+2W, ...
+        interval = concurrency / qps
+        next_t = time.monotonic() + (wid / qps)
+        while next_t < stop_at:
+            now = time.monotonic()
+            if now < next_t:
+                time.sleep(next_t - now)
+            elif now - next_t > interval:
+                # behind by a full slot: drop it, keep the offered rate honest
+                missed = int((now - next_t) / interval)
+                next_t += missed * interval
+                with lock:
+                    counts["sched_skipped"] += missed
+                continue
+            one_request()
+            next_t += interval
+
+    t_start = time.monotonic()
+    workers = [threading.Thread(
+        target=(open_worker if qps > 0 else closed_worker),
+        args=((i,) if qps > 0 else ()), daemon=True)
+        for i in range(concurrency)]
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join(timeout=duration_s + timeout_s + 30)
+    elapsed = time.monotonic() - t_start
+
+    total = sum(counts[c] for c in
+                ("ok", "overload", "deadline", "http_error"))
+    lat = hist.report()
+    return {
+        "mode": "open" if qps > 0 else "closed",
+        "url": url, "duration_s": round(elapsed, 3),
+        "concurrency": concurrency, "batch": batch,
+        "offered_qps": qps if qps > 0 else None,
+        "requests": total, "qps": round(total / elapsed, 2),
+        "rows_per_s": round(counts["rows_ok"] / elapsed, 2),
+        **counts,
+        "latency_seconds": lat,
+        # None (JSON null) when nothing was measured — e.g. server down,
+        # every request a net_error — keeping the report strict JSON
+        "p50_ms": None if lat["p50"] is None else round(lat["p50"] * 1e3, 3),
+        "p95_ms": None if lat["p95"] is None else round(lat["p95"] * 1e3, 3),
+        "p99_ms": None if lat["p99"] is None else round(lat["p99"] * 1e3, 3),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", default="http://127.0.0.1:8080")
+    ap.add_argument("--duration", type=float, default=5.0)
+    ap.add_argument("--concurrency", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=8,
+                    help="queries per request")
+    ap.add_argument("--qps", type=float, default=0.0,
+                    help=">0: open loop at this offered request rate")
+    ap.add_argument("--neighbors", action="store_true")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--scale", type=float, default=1.0,
+                    help="queries uniform in [0, scale)^3")
+    ap.add_argument("--out", default=None, help="write JSON report here")
+    a = ap.parse_args(argv)
+
+    report = run_load(a.url, duration_s=a.duration, concurrency=a.concurrency,
+                      batch=a.batch, qps=a.qps, neighbors=a.neighbors,
+                      timeout_s=a.timeout, seed=a.seed, scale=a.scale)
+    text = json.dumps(report, indent=2)
+    print(text)
+    if a.out:
+        with open(a.out, "w") as f:
+            f.write(text + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
