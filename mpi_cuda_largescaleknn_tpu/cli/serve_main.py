@@ -35,6 +35,9 @@ SERVE_FLAGS = """
   --max-batch N     widest padded query batch / shape bucket (default 1024)
   --min-batch N     narrowest shape bucket (default 8)
   --max-delay-ms F  micro-batch flush deadline (default 2.0)
+  --pipeline-depth N  batches in flight between dispatch and demux
+                    (default 2: next batch's device traversal overlaps the
+                    previous batch's host merge; 1 = fully serialized)
   --max-queue-rows N  admission cap on queued+running rows (default 4096)
   --timeout-ms F    default per-request deadline (default 5000)
   --no-warmup       skip compiling all shape buckets before serving
@@ -54,7 +57,8 @@ def parse_serve_args(argv: list[str]) -> dict:
     opt = {"k": 0, "max_radius": math.inf, "in_path": "", "port": 8080,
            "host": "127.0.0.1", "engine": "auto", "shards": None,
            "bucket_size": 0, "max_batch": 1024, "min_batch": 8,
-           "max_delay_ms": 2.0, "max_queue_rows": 4096,
+           "max_delay_ms": 2.0, "pipeline_depth": 2,
+           "max_queue_rows": 4096,
            "timeout_ms": 5000.0, "warmup": True, "timings": False,
            "verbose": False}
     i = 0
@@ -83,6 +87,8 @@ def parse_serve_args(argv: list[str]) -> dict:
                 i += 1; opt["min_batch"] = int(argv[i])
             elif arg == "--max-delay-ms":
                 i += 1; opt["max_delay_ms"] = float(argv[i])
+            elif arg == "--pipeline-depth":
+                i += 1; opt["pipeline_depth"] = int(argv[i])
             elif arg == "--max-queue-rows":
                 i += 1; opt["max_queue_rows"] = int(argv[i])
             elif arg == "--timeout-ms":
@@ -127,6 +133,7 @@ def main(argv: list[str] | None = None) -> int:
     server = build_server(
         engine, host=opt["host"], port=opt["port"],
         max_delay_s=opt["max_delay_ms"] / 1e3,
+        pipeline_depth=opt["pipeline_depth"],
         max_queue_rows=opt["max_queue_rows"],
         default_timeout_s=opt["timeout_ms"] / 1e3,
         verbose=opt["verbose"])

@@ -121,6 +121,7 @@ class PhaseRecord:
 class PhaseTimers:
     phases: dict[str, PhaseRecord] = field(default_factory=dict)
     histograms: dict[str, LatencyHistogram] = field(default_factory=dict)
+    gauges: dict[str, float] = field(default_factory=dict)
 
     @contextlib.contextmanager
     def phase(self, name: str, bytes_moved: int = 0):
@@ -142,6 +143,12 @@ class PhaseTimers:
             h = self.histograms[name] = LatencyHistogram()
         return h
 
+    def gauge(self, name: str, value: float) -> None:
+        """Point-in-time gauge (e.g. pipeline occupancy). Single dict store,
+        so concurrent writers are last-writer-wins — exactly gauge
+        semantics; no lock needed."""
+        self.gauges[name] = float(value)
+
     def report(self) -> dict:
         # list() snapshots: a serving /stats scrape may race a worker thread
         # inserting a new phase or histogram mid-iteration
@@ -150,6 +157,8 @@ class PhaseTimers:
                for name, r in list(self.phases.items())}
         for name, h in list(self.histograms.items()):
             out[name] = h.report()
+        for name, v in list(self.gauges.items()):
+            out[name] = v
         return out
 
     def dump(self) -> str:

@@ -716,6 +716,7 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
                      checkpoint_dir: str | None = None,
                      checkpoint_every: int = 1,
                      max_chunks: int | None = None,
+                     pipeline_depth: int = 2,
                      return_candidates: bool = False,
                      return_stats: bool = False):
     """``ring_knn`` with the query side streamed in fixed-size chunks.
@@ -736,6 +737,18 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
     results are persisted and a relaunch resumes at the first unfinished
     chunk (coarser-grained than ring_knn_stepwise's per-round snapshots, and
     far smaller state: results, not heaps).
+
+    Host/device pipelining (``pipeline_depth``, default 2): chunk c+1's
+    host staging (sentinel-pad + partition dispatch) runs while chunk c's
+    rounds are still in flight, and chunk c's result fetch (the only
+    blocking host sync in the loop) is deferred until up to
+    ``pipeline_depth`` chunks are pending — so the device never idles
+    waiting for numpy. Results are bit-identical at any depth (the pipeline
+    reorders nothing); depth 1 restores the fully serialized loop. Each
+    pending chunk holds one extra set of result buffers on device
+    (~``R * chunk_rows * k * 8`` bytes with candidates), the usual
+    double-buffering cost. A due checkpoint forces a full drain first, so
+    snapshots only ever record fully materialized chunks.
 
     Returns like ``ring_knn``: f32[R*Npad] shard-major distances (numpy),
     plus (dist2, idx) candidate arrays when ``return_candidates``.
@@ -883,7 +896,13 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
                   else min(max_chunks, n_chunks))
     tiles_parts = []  # materialized once at the end (see ring_knn_stepwise)
     chunks_run = 0
-    for c in range(start_chunk, stop_chunk):
+    depth = max(1, int(pipeline_depth))
+    pending = []  # chunks dispatched on device, results not yet fetched
+
+    def stage(c):
+        # host staging for chunk c: sentinel-pad, upload, dispatch the query
+        # partition + heap init. Everything device-side here is async
+        # dispatch, so staging chunk c+1 overlaps chunk c's in-flight rounds
         lo = c * chunk_rows
         hi = min(lo + chunk_rows, npad_local)
         qp = np.full((n_my, chunk_rows, 3), PAD_SENTINEL, np.float32)
@@ -894,6 +913,21 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
         stationary, heap = qinit(
             to_global(qp.reshape(-1, 3), num_shards * chunk_rows),
             to_global(qi.reshape(-1), num_shards * chunk_rows))
+        return lo, hi, stationary, heap
+
+    def drain_one():
+        # materialize the OLDEST pending chunk (the only blocking sync in
+        # the loop) — later chunks' rounds are already dispatched, so the
+        # device stays busy while the host copies rows out
+        lo, hi, d, hd2, hidx = pending.pop(0)
+        out_d[:, lo:hi] = local_rows(d, ())[:, :hi - lo]
+        if return_candidates:
+            out_hd2[:, lo:hi] = local_rows(hd2, (k,))[:, :hi - lo]
+            out_idx[:, lo:hi] = local_rows(hidx, (k,))[:, :hi - lo]
+
+    staged = stage(start_chunk) if start_chunk < stop_chunk else None
+    for c in range(start_chunk, stop_chunk):
+        lo, hi, stationary, heap = staged
         chunks_run += 1
         # pristine pair each chunk: the resident original never rotates, so
         # the traveling copies can be discarded wherever the sweep ends
@@ -907,12 +941,23 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
             if return_stats:
                 tiles_parts.append(tiles)
         d, hd2, hidx = final(stationary, heap)
-        out_d[:, lo:hi] = local_rows(d, ())[:, :hi - lo]
-        if return_candidates:
-            out_hd2[:, lo:hi] = local_rows(hd2, (k,))[:, :hi - lo]
-            out_idx[:, lo:hi] = local_rows(hidx, (k,))[:, :hi - lo]
-        if checkpoint_dir and ((c + 1) % checkpoint_every == 0
-                               or c + 1 == stop_chunk):
+        pending.append((lo, hi, d, hd2, hidx))
+        # drain down to depth-1 pending BEFORE staging the next chunk: at
+        # depth 1 that is exactly the serialized loop (fetch, then stage —
+        # no extra device buffers held), while deeper pipelines fetch the
+        # oldest chunk with this chunk's rounds still in flight, keeping the
+        # result copy off the next dispatch's critical path
+        while len(pending) >= depth:
+            drain_one()
+        if c + 1 < stop_chunk:
+            # double-buffer: pre-pad + pre-partition the next chunk while
+            # this chunk's rounds run
+            staged = stage(c + 1)
+        ckpt_due = checkpoint_dir and ((c + 1) % checkpoint_every == 0
+                                       or c + 1 == stop_chunk)
+        while pending and ckpt_due:
+            drain_one()
+        if ckpt_due:
             # snapshots are O(completed results) — at the target regime
             # (many chunks, k=100) keep checkpoint_every coarse enough that
             # write time stays small vs a chunk's ring
@@ -920,6 +965,8 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
             if return_candidates:
                 arrs.update(out_hd2=out_hd2, out_idx=out_idx)
             ckpt.save_ring_state(ckpt_dir, c + 1, arrs, fp)
+    while pending:
+        drain_one()
 
     if checkpoint_dir and stop_chunk == n_chunks:
         ckpt.clear(ckpt_dir)

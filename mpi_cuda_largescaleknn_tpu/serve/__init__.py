@@ -11,7 +11,11 @@ amortizes it across a request stream:
                  recompile.
 - ``batcher``  — dynamic micro-batching: queued queries coalesce into the
                  smallest covering shape bucket, flushing on max-batch or a
-                 latency deadline, with per-request demux.
+                 latency deadline, with per-request demux. With
+                 ``pipeline_depth > 1`` a dispatch worker keeps the next
+                 batch's device traversal in flight while a completion
+                 worker merges/demuxes the previous one (the engine's
+                 ``dispatch``/``complete`` split).
 - ``admission``— bounded queue + backpressure (explicit overload errors, not
                  unbounded growth), per-request deadlines, and graceful
                  degradation from the Pallas engine to the XLA twin.

@@ -36,6 +36,17 @@ class AdmissionController:
 
     Rows, not requests: one 1024-row request costs the engine what 1024
     singletons do, so the cap must count what the engine pays for.
+
+    The cap covers QUEUED + IN-FLIGHT rows by construction: ``admit``
+    reserves before a request enters the batcher queue and ``release``
+    fires only after its response demuxes, so rows dispatched on the device
+    under a deep pipeline stay counted the whole way. Sizing note for
+    pipelined serving: the device pipeline can hold up to
+    ``pipeline_depth * max_batch`` rows beyond the waiting queue, so
+    ``max_queue_rows`` below ``(pipeline_depth + 1) * max_batch`` caps
+    pipeline occupancy before the admission cap ever matters.
+    ``pipeline_rows_fn`` (wired by the server to the batcher) splits the
+    aggregate into its dispatched-on-device component for stats/metrics.
     """
 
     def __init__(self, max_queue_rows: int = 4096,
@@ -46,6 +57,9 @@ class AdmissionController:
         self._inflight_rows = 0
         self.admitted = 0
         self.rejected = 0
+        #: optional () -> int: rows currently dispatched on the device
+        #: (batcher.inflight_rows); reported in stats, not used for capping
+        self.pipeline_rows_fn = None
 
     def admit(self, n_rows: int) -> None:
         """Reserve ``n_rows`` of queue budget or raise ``OverloadError``.
@@ -73,10 +87,13 @@ class AdmissionController:
 
     def stats(self) -> dict:
         with self._lock:
-            return {"inflight_rows": self._inflight_rows,
-                    "max_queue_rows": self.max_queue_rows,
-                    "admitted": self.admitted,
-                    "rejected": self.rejected}
+            out = {"inflight_rows": self._inflight_rows,
+                   "max_queue_rows": self.max_queue_rows,
+                   "admitted": self.admitted,
+                   "rejected": self.rejected}
+        if self.pipeline_rows_fn is not None:
+            out["pipeline_inflight_rows"] = int(self.pipeline_rows_fn())
+        return out
 
 
 class _Admitted:
@@ -102,6 +119,16 @@ class GracefulQueryFn:
     (counted in ``compile_count`` like any compile); results are identical
     by the twin-engine contract, so callers never observe the swap except
     through stats.
+
+    The ``dispatch``/``complete`` pair mirrors the engine's pipelined
+    split. Async dispatch moves failure to where the result is FETCHED, so
+    a mid-stream Pallas failure surfaces in ``complete`` for a batch whose
+    dispatch already succeeded — the in-flight handle retains its host
+    queries and is replayed synchronously on the (now degraded) engine. A
+    stale handle that was dispatched on the old engine but fails after a
+    concurrent batch already triggered the degradation is replayed without
+    counting a second degradation; every queued batch therefore drains to a
+    correct answer, never an error, as long as the twin works.
     """
 
     def __init__(self, engine):
@@ -109,13 +136,41 @@ class GracefulQueryFn:
         self._lock = threading.Lock()
         self.failures = 0
 
+    def _degrade_or_raise(self, e: Exception, handle=None) -> None:
+        """Record a failure; degrade if possible, else re-raise ``e``.
+
+        Returns (instead of raising) when a replay can succeed: either this
+        failure triggered the degradation, or the engine was ALREADY
+        degraded after ``handle`` was dispatched (its recorded engine name
+        differs from the current one).
+        """
+        with self._lock:
+            self.failures += 1
+            if self.engine.can_degrade():
+                self.engine.degrade(f"{type(e).__name__}: {e}")
+            elif (handle is None or getattr(handle, "engine_name", None)
+                    == self.engine.engine_name):
+                raise e
+
     def __call__(self, queries):
         try:
             return self.engine.query(queries)
         except Exception as e:  # noqa: BLE001 - re-raised unless degradable
-            with self._lock:
-                self.failures += 1
-                if not self.engine.can_degrade():
-                    raise
-                self.engine.degrade(f"{type(e).__name__}: {e}")
+            self._degrade_or_raise(e)
             return self.engine.query(queries)
+
+    def dispatch(self, queries):
+        try:
+            return self.engine.dispatch(queries)
+        except Exception as e:  # noqa: BLE001 - re-raised unless degradable
+            self._degrade_or_raise(e)
+            return self.engine.dispatch(queries)
+
+    def complete(self, handle):
+        try:
+            return self.engine.complete(handle)
+        except Exception as e:  # noqa: BLE001 - re-raised unless degradable
+            self._degrade_or_raise(e, handle)
+            # replay the retained host queries synchronously on the current
+            # (degraded) engine — exact by the twin-engine contract
+            return self.engine.query(handle.queries)

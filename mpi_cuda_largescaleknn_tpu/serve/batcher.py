@@ -10,6 +10,18 @@ whole requests (never splitting one across engine calls keeps demux
 trivial), pads to the smallest covering shape bucket inside the engine, and
 demuxes per-request slices back to each caller.
 
+Pipelining (``pipeline_depth > 1``): when ``query_fn`` exposes the engine's
+``dispatch``/``complete`` split, flushes run on a DISPATCH worker that
+launches batch t+1's device traversal while a COMPLETION worker blocks on
+batch t's fetch, merges, and demuxes — device compute overlaps host
+staging/merge instead of serializing behind it. A semaphore bounds the
+batches in flight between dispatch and demux at ``pipeline_depth``; the
+time the dispatch worker spends blocked on that bound is the pipeline's
+stall metric (recorded in the shared obs/timers.py histogram geometry).
+Completion order is FIFO in batch order, so per-request demux slices can
+never cross batches. ``pipeline_depth=1`` (the default) keeps the original
+single-worker serialized path bit-for-bit.
+
 Deadlines: a request whose deadline passed while queued is completed with
 ``DeadlineExceeded`` instead of burning engine time on an answer nobody is
 waiting for.
@@ -17,6 +29,7 @@ waiting for.
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
 from collections import deque
@@ -24,6 +37,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from mpi_cuda_largescaleknn_tpu.obs.timers import LatencyHistogram
 from mpi_cuda_largescaleknn_tpu.serve.admission import DeadlineExceeded
 
 
@@ -42,20 +56,30 @@ class _Request:
 
 
 class DynamicBatcher:
-    """Single worker thread draining a request queue through ``query_fn``.
+    """Worker thread(s) draining a request queue through ``query_fn``.
 
     ``query_fn(queries f32[n,3]) -> (dists f32[n], neighbors i32[n,k])`` —
     typically ``admission.GracefulQueryFn`` wrapping a ResidentKnnEngine.
+    With ``pipeline_depth > 1`` the wrapper's ``dispatch``/``complete``
+    split is used instead (falling back to the serialized path when the
+    callable lacks it — e.g. test doubles that are plain functions).
     """
 
     def __init__(self, query_fn, *, max_batch: int,
-                 max_delay_s: float = 0.002, timers=None):
+                 max_delay_s: float = 0.002, timers=None,
+                 pipeline_depth: int = 1):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
+        if pipeline_depth < 1:
+            raise ValueError("pipeline_depth must be >= 1")
         self._query_fn = query_fn
         self.max_batch = int(max_batch)
         self.max_delay_s = float(max_delay_s)
         self._timers = timers
+        self.pipeline_depth = int(pipeline_depth)
+        self.pipelined = (self.pipeline_depth > 1
+                          and hasattr(query_fn, "dispatch")
+                          and hasattr(query_fn, "complete"))
         self._cond = threading.Condition()
         self._queue: deque[_Request] = deque()
         self._queued_rows = 0
@@ -66,9 +90,30 @@ class DynamicBatcher:
         self.rows_expired = 0
         self.flush_full = 0
         self.flush_deadline = 0
-        self._worker = threading.Thread(target=self._run, daemon=True,
-                                        name="knn-batcher")
-        self._worker.start()
+        # pipeline occupancy/stall accounting (under _cond); the stall
+        # histogram shares the loadgen/server bucket geometry so the three
+        # render identical /metrics buckets
+        self._inflight_batches = 0
+        self._inflight_rows = 0
+        self.dispatch_stalls = 0
+        self.dispatch_stall_seconds = 0.0
+        self.stall_hist = (timers.hist("pipeline_stall_seconds")
+                           if timers is not None else LatencyHistogram())
+        self._workers: list[threading.Thread] = []
+        if self.pipelined:
+            self._inflight: queue.Queue = queue.Queue()
+            self._slots = threading.Semaphore(self.pipeline_depth)
+            self._workers = [
+                threading.Thread(target=self._run_dispatch, daemon=True,
+                                 name="knn-batcher-dispatch"),
+                threading.Thread(target=self._run_complete, daemon=True,
+                                 name="knn-batcher-complete"),
+            ]
+        else:
+            self._workers = [threading.Thread(target=self._run, daemon=True,
+                                              name="knn-batcher")]
+        for w in self._workers:
+            w.start()
 
     # ------------------------------------------------------------------ submit
 
@@ -98,7 +143,16 @@ class DynamicBatcher:
     # ------------------------------------------------------------------ worker
 
     def _take_batch(self) -> list[_Request] | None:
-        """Wait for a flushable batch; None on shutdown."""
+        """Wait for a flushable batch; None on shutdown.
+
+        Batch-while-busy: under pipelining, the ``max_delay_s`` flush only
+        fires while NO batch is in flight. While the device is busy, an
+        early partial flush cannot start any sooner than the in-flight work
+        it would queue behind — it can only narrow the batch — so the queue
+        keeps accumulating toward a full flush until the device frees up
+        (the completion worker notifies). Keeps pipelined batches as wide
+        as serialized ones instead of racing ahead on 2ms slivers.
+        """
         with self._cond:
             while True:
                 if self._shutdown and not self._queue:
@@ -108,9 +162,12 @@ class DynamicBatcher:
                     flush_at = oldest.enqueued + self.max_delay_s
                     now = time.monotonic()
                     if (self._queued_rows >= self.max_batch
-                            or now >= flush_at or self._shutdown):
+                            or (now >= flush_at
+                                and self._inflight_batches == 0)
+                            or self._shutdown):
                         break
-                    self._cond.wait(flush_at - now)
+                    self._cond.wait(None if self._inflight_batches
+                                    else flush_at - now)
                 else:
                     self._cond.wait()
             # pop whole requests while they fit; a single over-wide request
@@ -130,22 +187,43 @@ class DynamicBatcher:
                 self.flush_deadline += 1
             return batch
 
-    def _run(self):
-        while True:
-            batch = self._take_batch()
-            if batch is None:
-                return
-            now = time.monotonic()
-            live, expired = [], []
-            for r in batch:
-                (expired if (r.deadline is not None and now > r.deadline)
-                 else live).append(r)
-            for r in expired:
+    def _split_expired(self, batch: list[_Request]) -> list[_Request]:
+        """Fail deadline-expired requests now; return the live remainder."""
+        now = time.monotonic()
+        live = []
+        for r in batch:
+            if r.deadline is not None and now > r.deadline:
                 with self._cond:
                     self.rows_expired += r.rows
                 r.error = DeadlineExceeded(
                     f"deadline passed after {now - r.enqueued:.3f}s in queue")
                 r.done.set()
+            else:
+                live.append(r)
+        return live
+
+    @staticmethod
+    def _deliver(live: list[_Request], dists, nbrs) -> None:
+        off = 0
+        for r in live:
+            r.result = (dists[off:off + r.rows], nbrs[off:off + r.rows])
+            off += r.rows
+            r.done.set()
+
+    @staticmethod
+    def _fail(live: list[_Request], err: Exception) -> None:
+        for r in live:
+            r.error = err
+            r.done.set()
+
+    # -------------------------------------------------- serialized (depth 1)
+
+    def _run(self):
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            live = self._split_expired(batch)
             if not live:
                 continue
             try:
@@ -156,24 +234,112 @@ class DynamicBatcher:
                 if self._timers is not None:
                     self._timers.hist("batch_exec_seconds").record(
                         time.perf_counter() - t0)
-                off = 0
-                for r in live:
-                    r.result = (dists[off:off + r.rows],
-                                nbrs[off:off + r.rows])
-                    off += r.rows
-                    r.done.set()
+                self._deliver(live, dists, nbrs)
                 with self._cond:
                     self.rows_served += len(merged)
             except Exception as e:  # noqa: BLE001 - delivered per request
-                for r in live:
-                    r.error = e
-                    r.done.set()
+                self._fail(live, e)
+
+    # -------------------------------------------------- pipelined (depth > 1)
+
+    def _run_dispatch(self):
+        """Flush loop: launch device work, hand futures to the completer.
+
+        Blocks (recording stall time) when ``pipeline_depth`` batches are
+        already between dispatch and demux — that bound is what keeps a
+        fast producer from piling unmerged device results without limit.
+        """
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                # FIFO sentinel: the completer drains everything already
+                # dispatched, then exits — a clean pipeline drain
+                self._inflight.put(None)
+                return
+            live = self._split_expired(batch)
+            if not live:
+                continue
+            merged = (live[0].queries if len(live) == 1 else
+                      np.concatenate([r.queries for r in live]))
+            if not self._slots.acquire(blocking=False):
+                t0 = time.perf_counter()
+                self._slots.acquire()
+                stall = time.perf_counter() - t0
+                self.stall_hist.record(stall)
+                with self._cond:
+                    self.dispatch_stalls += 1
+                    self.dispatch_stall_seconds += stall
+            with self._cond:
+                self._inflight_batches += 1
+                self._inflight_rows += len(merged)
+            if self._timers is not None:
+                self._timers.gauge("pipeline_inflight_batches",
+                                   self._inflight_batches)
+            try:
+                t0 = time.perf_counter()
+                handle = self._query_fn.dispatch(merged)
+            except Exception as e:  # noqa: BLE001 - delivered per request
+                self._fail(live, e)
+                with self._cond:
+                    self._inflight_batches -= 1
+                    self._inflight_rows -= len(merged)
+                    self._cond.notify_all()
+                if self._timers is not None:
+                    self._timers.gauge("pipeline_inflight_batches",
+                                       self._inflight_batches)
+                self._slots.release()
+                continue
+            self._inflight.put((live, len(merged), handle, t0))
+
+    def _run_complete(self):
+        """Completion loop: block on the oldest in-flight batch, demux.
+
+        FIFO order means a batch's demux can start the moment ITS device
+        work lands, while later batches are still traversing — and request
+        ordering within a batch is preserved by the offset demux.
+        """
+        while True:
+            item = self._inflight.get()
+            if item is None:
+                return
+            live, rows, handle, t0 = item
+            try:
+                dists, nbrs = self._query_fn.complete(handle)
+                if self._timers is not None:
+                    self._timers.hist("batch_exec_seconds").record(
+                        time.perf_counter() - t0)
+                self._deliver(live, dists, nbrs)
+                with self._cond:
+                    self.rows_served += rows
+            except Exception as e:  # noqa: BLE001 - delivered per request
+                self._fail(live, e)
+            finally:
+                with self._cond:
+                    self._inflight_batches -= 1
+                    self._inflight_rows -= rows
+                    # wake a dispatch worker parked on batch-while-busy: the
+                    # device freed a slot, so a deadline flush is allowed now
+                    self._cond.notify_all()
+                if self._timers is not None:
+                    self._timers.gauge("pipeline_inflight_batches",
+                                       self._inflight_batches)
+                self._slots.release()
 
     # ------------------------------------------------------------------- admin
 
     def queue_depth_rows(self) -> int:
         with self._cond:
             return self._queued_rows
+
+    def inflight_rows(self) -> int:
+        """Rows dispatched on the device but not yet demuxed (0 when
+        serialized — the single worker holds no futures between flushes)."""
+        with self._cond:
+            return self._inflight_rows
+
+    def inflight_batches(self) -> int:
+        with self._cond:
+            return self._inflight_batches
 
     def stats(self) -> dict:
         with self._cond:
@@ -186,6 +352,13 @@ class DynamicBatcher:
                 "queue_rows": self._queued_rows,
                 "mean_batch_rows": round(
                     self.rows_served / self.batches, 2) if self.batches else 0,
+                "pipeline_depth": self.pipeline_depth,
+                "pipelined": self.pipelined,
+                "inflight_batches": self._inflight_batches,
+                "inflight_rows": self._inflight_rows,
+                "dispatch_stalls": self.dispatch_stalls,
+                "dispatch_stall_seconds": round(
+                    self.dispatch_stall_seconds, 6),
             }
 
     def shutdown(self, wait: bool = True):
@@ -193,4 +366,5 @@ class DynamicBatcher:
             self._shutdown = True
             self._cond.notify_all()
         if wait:
-            self._worker.join(timeout=10)
+            for w in self._workers:
+                w.join(timeout=10)

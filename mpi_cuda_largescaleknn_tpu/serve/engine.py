@@ -16,13 +16,23 @@ served shape can NEVER silently retrace — an unexpected shape raises, and
 on. ``auto`` resolves to the Pallas kernel on TPU / the XLA twin elsewhere
 (parallel/ring.py resolve_engine); a runtime Pallas failure degrades to the
 twin via ``degrade()`` (driven by serve/admission.py).
+
+Pipelining: ``query`` is split into an async ``dispatch`` (stage + pad +
+queue the AOT executable call on a single launch thread, so dispatch
+returns right after staging even where PJRT executes synchronously) and a
+blocking ``complete`` (resolve the launch future, fetch, R-way merge,
+slice) so the batcher can keep batch t+1's device traversal in flight while
+batch t's host merge runs — the serving-side analogue of the ring's
+communication/compute overlap.
 """
 
 from __future__ import annotations
 
 import math
+import os
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
@@ -38,6 +48,28 @@ from mpi_cuda_largescaleknn_tpu.utils.math import next_pow2
 class UnservableShapeError(ValueError):
     """A batch no shape bucket covers reached the engine (the admission
     layer should have rejected or split it)."""
+
+
+class _InFlightBatch:
+    """A dispatched-but-uncompleted engine call (``dispatch`` -> ``complete``).
+
+    ``fut`` resolves to the executable's (d2, idx) result pair on the
+    engine's launch thread; ``queries`` retains the original host batch so a
+    completion-time failure (async Pallas errors surface at fetch, not at
+    launch) can be replayed on the degraded twin. ``engine_name`` records
+    which engine DISPATCHED it — after a mid-stream degradation, stale
+    handles are distinguishable from twin failures.
+    """
+
+    __slots__ = ("queries", "n", "qpad", "engine_name", "fut", "t0")
+
+    def __init__(self, queries, n, qpad, engine_name, fut, t0):
+        self.queries = queries
+        self.n = n
+        self.qpad = qpad
+        self.engine_name = engine_name
+        self.fut = fut
+        self.t0 = t0
 
 
 class ResidentKnnEngine:
@@ -88,6 +120,19 @@ class ResidentKnnEngine:
         self.degraded_reason: str | None = None
         self._lock = threading.Lock()
         self._executables: dict = {}   # (engine_name, qpad) -> AOT executable
+        # launch pool: ``dispatch`` hands the executable call here and
+        # returns after staging, so the dispatch stage never blocks on
+        # device compute — even on backends whose PJRT client executes
+        # synchronously (this container's CPU pin; TPU dispatch is natively
+        # async and the hop is ~50us). The pool is the CPU stand-in for the
+        # device's async program queue: 1 worker keeps launches strictly
+        # FIFO; the server widens it to the pipeline depth so a depth-d
+        # pipeline can keep d fixed-shape programs in flight (executions
+        # are pure reads of the resident index, so concurrent launches
+        # cannot race; result DELIVERY order is the batcher's FIFO queue)
+        self._launch_workers = 1
+        self._launch = ThreadPoolExecutor(max_workers=1,
+                                          thread_name_prefix="knn-launch")
 
         with self.timers.phase("index_build"):
             self._build_index(points, jax)
@@ -171,9 +216,17 @@ class ResidentKnnEngine:
             in_specs = (P(AXIS),) * 2 + (P(),)
 
         check_vma = not engine_name.startswith("pallas")
+        # donate the staged query buffer: each dispatch stages a fresh
+        # replicated batch, so the previous one's device memory is dead the
+        # moment the executable reads it — donation lets XLA reuse it for
+        # the outputs instead of growing the pipelined working set. TPU
+        # only: the CPU PJRT client logs unusable-donation warnings.
+        donate = ((len(in_specs) - 1,)
+                  if jax.default_backend() == "tpu" else ())
         return jax.jit(jax.shard_map(
             body, mesh=self.mesh, in_specs=in_specs,
-            out_specs=(P(AXIS), P(AXIS)), check_vma=check_vma))
+            out_specs=(P(AXIS), P(AXIS)), check_vma=check_vma),
+            donate_argnums=donate)
 
     def _resident_args(self, engine_name: str):
         if engine_name in ("tiled", "pallas_tiled"):
@@ -235,52 +288,119 @@ class ResidentKnnEngine:
         """Swap the Pallas traversal for its XLA twin after a runtime
         failure (identical results by the twin-engine contract — see
         tests/test_pallas_tiled.py). Compiled twin programs are cached under
-        their own key, so repeated degradations never recompile."""
-        if not self.can_degrade():
-            raise RuntimeError(
-                f"engine '{self.engine_name}' has no fallback")
-        self.degraded_reason = reason
-        self.engine_name = "tiled"
-        # the twin may want a different tuned bucket geometry, but the index
-        # is already partitioned — keep the resident geometry, stay exact
+        their own key, so repeated degradations never recompile.
+
+        Takes the engine lock: ``dispatch`` reads ``engine_name`` and picks
+        the matching executable under that lock, so a mid-dispatch
+        degradation can never produce a handle whose recorded engine name
+        disagrees with the executable it actually launched (the stale-handle
+        replay in admission.GracefulQueryFn depends on that agreement)."""
+        with self._lock:
+            if not self.can_degrade():
+                raise RuntimeError(
+                    f"engine '{self.engine_name}' has no fallback")
+            self.degraded_reason = reason
+            self.engine_name = "tiled"
+            # the twin may want a different tuned bucket geometry, but the
+            # index is already partitioned — keep the resident geometry,
+            # stay exact
 
     # ------------------------------------------------------------------- query
 
-    def query(self, queries: np.ndarray):
-        """f32[n,3] -> (f32[n] k-th-NN distances, i32[n,k] neighbor ids).
+    def set_launch_workers(self, n: int) -> None:
+        """Resize the launch pool toward ``n`` concurrent program launches.
 
-        ``n`` may be anything in [0, max_batch]; the batch is padded to its
-        shape bucket. Larger batches are the batcher's/admission's job to
-        split. Distances follow the reference contract: sqrt of the k-th
-        smallest squared distance, inf (or the ``-r`` radius) when fewer
-        than k neighbors exist. Neighbor ids are global point indices,
-        ascending by distance, -1 for unfilled slots.
+        The serving layer asks for its pipeline depth; the engine clamps to
+        what concurrency can actually buy: on the CPU backend one program
+        already spans ``num_shards`` device threads, so extra launches only
+        help while programs leave cores idle (a second launch on a saturated
+        host just thrashes caches — measured slower). With one worker the
+        pool still pipelines: the next staged batch launches the instant the
+        current one retires, with no host work in between. Futures already
+        submitted to the old pool complete unaffected (their threads drain
+        and exit); a no-op when the size is unchanged.
+        """
+        import jax
+
+        n = max(1, int(n))
+        if jax.default_backend() != "tpu":
+            cores = os.cpu_count() or 1
+            n = max(1, min(n, cores // max(1, self.num_shards)))
+        with self._lock:
+            if n == self._launch_workers:
+                return
+            old = self._launch
+            self._launch_workers = n
+            self._launch = ThreadPoolExecutor(
+                max_workers=n, thread_name_prefix="knn-launch")
+            old.shutdown(wait=False)
+
+    def dispatch(self, queries: np.ndarray) -> _InFlightBatch:
+        """Issue a batch's device traversal WITHOUT blocking on the result.
+
+        Stages + pads the batch, replicates it, and hands the AOT
+        executable call to the engine's single launch thread; the returned
+        ``_InFlightBatch`` wraps the launch future. Between ``dispatch`` and
+        ``complete`` the device crunches while the host is free to merge an
+        earlier batch (the batcher's pipelined mode) or stage the next one.
+        The lock serializes executable lookup, staging, and launch-queue
+        order with ``degrade``; it is NOT held while the device computes or
+        the host merges.
         """
         import jax
 
         queries = np.asarray(queries, np.float32).reshape(-1, 3)
         n = len(queries)
         if n == 0:
-            return (np.zeros(0, np.float32),
-                    np.zeros((0, self.k), np.int32))
+            return _InFlightBatch(queries, 0, 0, self.engine_name,
+                                  None, time.perf_counter())
         qpad = self.bucket_for(n)
-
         with self._lock:
             exe = self._get_executable(qpad)
+            engine_name = self.engine_name
+            args = self._resident_args(engine_name)
             q = np.full((qpad, 3), PAD_SENTINEL, np.float32)
             q[:n] = queries
             t0 = time.perf_counter()
             q_dev = jax.device_put(q, self._replicated)
-            d2, idx = exe(*self._resident_args(self.engine_name), q_dev)
-            d2 = np.asarray(d2)
-            idx = np.asarray(idx)
-            self.timers.hist("engine_batch_seconds").record(
-                time.perf_counter() - t0)
+            fut = self._launch.submit(exe, *args, q_dev)
+        return _InFlightBatch(queries, n, qpad, engine_name, fut, t0)
 
+    def complete(self, batch: _InFlightBatch):
+        """Block on a dispatched batch and merge its R-way partial top-k.
+
+        The future resolution + np.asarray fetches are where async dispatch
+        errors surface (a Pallas runtime failure raises HERE, not in
+        ``dispatch``) — the graceful wrapper replays the handle's retained
+        queries on the twin. ``engine_batch_seconds`` measures
+        dispatch->fetch wall-clock, which under pipelining includes time
+        queued behind the previous batch.
+        """
+        if batch.n == 0:
+            return (np.zeros(0, np.float32),
+                    np.zeros((0, self.k), np.int32))
+        d2, idx = batch.fut.result()
+        d2 = np.asarray(d2)
+        idx = np.asarray(idx)
+        self.timers.hist("engine_batch_seconds").record(
+            time.perf_counter() - batch.t0)
         with self.timers.phase("host_merge"):
             dists, nbrs = _merge_shard_candidates(
-                d2, idx, self.num_shards, qpad, self.k)
-        return dists[:n], nbrs[:n]
+                d2, idx, self.num_shards, batch.qpad, self.k)
+        return dists[:batch.n], nbrs[:batch.n]
+
+    def query(self, queries: np.ndarray):
+        """f32[n,3] -> (f32[n] k-th-NN distances, i32[n,k] neighbor ids).
+
+        Serialized ``dispatch`` + ``complete``. ``n`` may be anything in
+        [0, max_batch]; the batch is padded to its shape bucket. Larger
+        batches are the batcher's/admission's job to split. Distances follow
+        the reference contract: sqrt of the k-th smallest squared distance,
+        inf (or the ``-r`` radius) when fewer than k neighbors exist.
+        Neighbor ids are global point indices, ascending by distance, -1 for
+        unfilled slots.
+        """
+        return self.complete(self.dispatch(queries))
 
     def stats(self) -> dict:
         # list() snapshots _executables atomically: a scrape may race a

@@ -3,7 +3,8 @@
 No web framework (the container bakes no deps beyond the jax toolchain):
 ``http.server.ThreadingHTTPServer`` with one handler thread per connection.
 Handler threads only parse, admit, and block on the batcher's demux event —
-all engine work happens on the batcher's single worker thread, so JAX
+all engine dispatch happens on the batcher's dispatch worker (plus one
+completion worker for the host merge when ``pipeline_depth > 1``), so JAX
 dispatch stays single-threaded no matter how many clients connect.
 
 Request formats on POST /knn:
@@ -57,17 +58,26 @@ class KnnServer(ThreadingHTTPServer):
 
     def __init__(self, addr, engine, *, max_delay_s=0.002,
                  max_queue_rows=4096, default_timeout_s=5.0, query_fn=None,
-                 verbose=False):
+                 verbose=False, pipeline_depth=2):
         self.engine = engine
         self.admission = AdmissionController(
             max_queue_rows=max_queue_rows,
             default_timeout_s=default_timeout_s)
         self.graceful = (GracefulQueryFn(engine) if query_fn is None
                          else query_fn)
+        # depth 2 by default: batch t+1's device traversal overlaps batch
+        # t's host merge/demux (results identical to depth 1 — the pipeline
+        # reorders nothing, it only overlaps). See docs/SERVING.md.
         self.batcher = DynamicBatcher(self.graceful,
                                       max_batch=engine.max_batch,
                                       max_delay_s=max_delay_s,
-                                      timers=engine.timers)
+                                      timers=engine.timers,
+                                      pipeline_depth=pipeline_depth)
+        self.admission.pipeline_rows_fn = self.batcher.inflight_rows
+        if self.batcher.pipelined and hasattr(engine, "set_launch_workers"):
+            # let the engine keep as many programs in flight as the
+            # pipeline can hand it (its async-program-queue stand-in)
+            engine.set_launch_workers(pipeline_depth)
         self.metrics = ServingMetrics()
         self.ready = False
         self.verbose = verbose
@@ -149,14 +159,27 @@ class _Handler(BaseHTTPRequestHandler):
             "knn_admission_rejected_total": a["rejected"],
             "knn_batches_total": b["batches"],
             "knn_batch_rows_served_total": b["rows_served"],
+            # pipeline occupancy: configured depth, batches/rows currently
+            # between dispatch and demux, and cumulative dispatch stalls
+            # (dispatch worker blocked on the depth bound)
+            "knn_pipeline_depth": b["pipeline_depth"],
+            "knn_pipeline_inflight_batches": b["inflight_batches"],
+            "knn_pipeline_inflight_rows": b["inflight_rows"],
+            "knn_pipeline_dispatch_stalls_total": b["dispatch_stalls"],
+            "knn_pipeline_dispatch_stall_seconds_total":
+                b["dispatch_stall_seconds"],
         }
         for name, val in gauges.items():
             lines += [f"# TYPE {name} gauge", f"{name} {val}"]
         lines += srv.metrics.latency.prometheus_lines(
             "knn_request_latency_seconds")
-        hist = srv.engine.timers.histograms.get("engine_batch_seconds")
-        if hist is not None:
-            lines += hist.prometheus_lines("knn_engine_batch_seconds")
+        for src, prom in (("engine_batch_seconds",
+                           "knn_engine_batch_seconds"),
+                          ("pipeline_stall_seconds",
+                           "knn_pipeline_stall_seconds")):
+            hist = srv.engine.timers.histograms.get(src)
+            if hist is not None:
+                lines += hist.prometheus_lines(prom)
         return "\n".join(lines) + "\n"
 
     # ------------------------------------------------------------------ POST

@@ -29,6 +29,39 @@ def test_chunked_matches_fused(chunk_rows):
     np.testing.assert_array_equal(fused, chunked)
 
 
+@pytest.mark.parametrize("depth", [1, 2, 3])
+def test_chunked_pipeline_depths_identical(depth):
+    """The host/device pipeline (pre-staged chunk c+1, deferred result
+    fetch) must be invisible in the results at every depth — including
+    depth 1, the fully serialized loop."""
+    pts = random_points(520, seed=17)
+    mesh = get_mesh(8)
+    flat, ids, _, _ = _sharded(pts, 8)
+    fused = np.asarray(ring_knn(flat, ids, 6, mesh, bucket_size=16))
+    got = ring_knn_chunked(flat, ids, 6, mesh, chunk_rows=16,
+                           bucket_size=16, pipeline_depth=depth)
+    np.testing.assert_array_equal(fused, got)
+
+
+def test_chunked_pipelined_checkpoint_resume(tmp_path):
+    """Checkpointing forces a pipeline drain before each snapshot: a
+    pipelined run interrupted mid-stream must resume to the exact result."""
+    pts = random_points(512, seed=19)
+    mesh = get_mesh(8)
+    flat, ids, _, _ = _sharded(pts, 8)
+    cdir = str(tmp_path / "ck")
+    want = ring_knn_chunked(flat, ids, 5, mesh, chunk_rows=16,
+                            bucket_size=16, pipeline_depth=3)
+    partial = ring_knn_chunked(flat, ids, 5, mesh, chunk_rows=16,
+                               bucket_size=16, checkpoint_dir=cdir,
+                               max_chunks=2, pipeline_depth=3)
+    assert not np.array_equal(partial, want)  # later chunks still inf
+    resumed = ring_knn_chunked(flat, ids, 5, mesh, chunk_rows=16,
+                               bucket_size=16, checkpoint_dir=cdir,
+                               pipeline_depth=3)
+    np.testing.assert_array_equal(resumed, want)
+
+
 def test_chunked_with_candidates():
     pts = random_points(256, seed=5)
     mesh = get_mesh(8)

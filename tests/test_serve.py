@@ -230,6 +230,222 @@ class TestBatcher:
             b.shutdown()
 
 
+class TestPipelinedBatcher:
+    """The pipelined dispatch/complete path: overlap must change throughput,
+    never results, ordering, or compile counts."""
+
+    @staticmethod
+    def _submit_all(b, qs, timeout_s=60):
+        out = [None] * len(qs)
+        errs = [None] * len(qs)
+
+        def call(i):
+            try:
+                out[i] = b.submit(qs[i], timeout_s=timeout_s)
+            except Exception as e:  # noqa: BLE001 - asserted by callers
+                errs[i] = e
+
+        ths = [threading.Thread(target=call, args=(i,))
+               for i in range(len(qs))]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        return out, errs
+
+    @pytest.mark.parametrize("depth", [1, 2, 4])
+    def test_oracle_exact_at_depth(self, engine, index_points, depth):
+        """The ISSUE's acceptance bar: pipelined results are oracle-exact at
+        every depth — the pipeline overlaps, it never reorders or mixes."""
+        b = DynamicBatcher(GracefulQueryFn(engine), max_batch=engine.max_batch,
+                           max_delay_s=0.002, pipeline_depth=depth)
+        try:
+            assert b.pipelined == (depth > 1)
+            sizes = (3, 17, 30, 9, 64, 5, 40, 12, 1, 100)
+            qs = [random_points(n, seed=300 + n) for n in sizes]
+            out, errs = self._submit_all(b, qs)
+            assert all(e is None for e in errs), errs
+            for q, (d, nbrs) in zip(qs, out):
+                assert_dist_equal(d, kth_nn_dist(q, index_points, K))
+                assert nbrs.shape == (len(q), K)
+            assert b.inflight_batches() == 0 and b.inflight_rows() == 0
+        finally:
+            b.shutdown()
+
+    def test_dispatch_complete_equals_query(self, engine):
+        q = random_points(23, seed=41)
+        want_d, want_n = engine.query(q)
+        got_d, got_n = engine.complete(engine.dispatch(q))
+        np.testing.assert_array_equal(want_d, got_d)
+        np.testing.assert_array_equal(want_n, got_n)
+
+    def test_compile_count_unchanged_vs_serialized(self, engine):
+        """Pipelining must not change WHICH programs run: depth-2 traffic
+        across every shape bucket adds zero compiles beyond warmup."""
+        warm = engine.compile_count
+        b = DynamicBatcher(GracefulQueryFn(engine), max_batch=engine.max_batch,
+                           max_delay_s=0.001, pipeline_depth=2)
+        try:
+            qs = [random_points(n, seed=n) for n in (1, 3, 17, 100, 64, 33)]
+            out, errs = self._submit_all(b, qs)
+            assert all(e is None for e in errs), errs
+        finally:
+            b.shutdown()
+        assert engine.compile_count == warm
+
+    def test_ordering_preserved_under_concurrent_flushes(self):
+        """Each caller gets exactly its own rows back even when many flushes
+        are in flight concurrently: handles complete FIFO and demux offsets
+        never cross batches. The fake tags every row with the request's
+        marker value, completion is deliberately slow to force overlap."""
+
+        class SlowEcho:
+            def dispatch(self, q):
+                return q
+
+            def complete(self, q):
+                time.sleep(0.005)
+                return q[:, 0].copy(), np.arange(
+                    len(q), dtype=np.int32)[:, None]
+
+        b = DynamicBatcher(SlowEcho(), max_batch=32, max_delay_s=0.001,
+                           pipeline_depth=3)
+        try:
+            qs = []
+            for i in range(24):
+                q = random_points(1 + (i % 5), seed=500 + i)
+                q[:, 0] = i  # marker: row ownership is checkable
+                qs.append(q)
+            out, errs = self._submit_all(b, qs, timeout_s=30)
+            assert all(e is None for e in errs), errs
+            for i, (d, _nbrs) in enumerate(out):
+                np.testing.assert_array_equal(d, np.full(len(qs[i]), i,
+                                                         np.float32))
+        finally:
+            b.shutdown()
+
+    def test_pipeline_drains_on_midstream_degradation(self):
+        """Pallas dies at COMPLETION time (async errors surface at fetch)
+        with several batches already in flight: every request must still get
+        a correct answer via the twin replay, the pipeline must drain to
+        zero occupancy, and only one degradation may be recorded."""
+
+        class FakeHandle:
+            def __init__(self, q, engine_name):
+                self.queries = q
+                self.engine_name = engine_name
+
+        class FakeEngine:
+            def __init__(self):
+                self.engine_name = "pallas_tiled"
+                self.degraded_reason = None
+
+            def can_degrade(self):
+                return self.engine_name == "pallas_tiled"
+
+            def degrade(self, reason):
+                self.degraded_reason = reason
+                self.engine_name = "tiled"
+
+            def dispatch(self, q):
+                return FakeHandle(np.asarray(q), self.engine_name)
+
+            def complete(self, h):
+                time.sleep(0.002)
+                if h.engine_name == "pallas_tiled":
+                    raise RuntimeError("pallas runtime failure at fetch")
+                return h.queries[:, 0].copy(), np.arange(
+                    len(h.queries), dtype=np.int32)[:, None]
+
+            def query(self, q):
+                return self.complete(self.dispatch(q))
+
+        fake = FakeEngine()
+        g = GracefulQueryFn(fake)
+        b = DynamicBatcher(g, max_batch=8, max_delay_s=0.001,
+                           pipeline_depth=3)
+        try:
+            qs = [random_points(4, seed=600 + i) for i in range(10)]
+            out, errs = self._submit_all(b, qs, timeout_s=30)
+            assert all(e is None for e in errs), errs
+            for q, (d, _n) in zip(qs, out):
+                np.testing.assert_array_equal(d, q[:, 0])
+            assert fake.engine_name == "tiled"
+            assert "pallas runtime failure" in fake.degraded_reason
+            assert g.failures >= 1
+            assert b.inflight_batches() == 0 and b.inflight_rows() == 0
+            # still serving after the drain
+            q = random_points(3, seed=999)
+            d, _ = b.submit(q, timeout_s=10)
+            np.testing.assert_array_equal(d, q[:, 0])
+        finally:
+            b.shutdown()
+
+    def test_dispatch_time_failure_degrades_too(self):
+        """A failure at DISPATCH (sync lowering error) follows the same
+        degrade-and-retry path as the serialized wrapper."""
+
+        class FakeEngine:
+            def __init__(self):
+                self.engine_name = "pallas_tiled"
+                self.degraded_reason = None
+
+            def can_degrade(self):
+                return self.engine_name == "pallas_tiled"
+
+            def degrade(self, reason):
+                self.degraded_reason = reason
+                self.engine_name = "tiled"
+
+            def dispatch(self, q):
+                if self.engine_name == "pallas_tiled":
+                    raise RuntimeError("lowering failed")
+                return np.asarray(q)
+
+            def complete(self, q):
+                return q[:, 0].copy(), np.zeros((len(q), 1), np.int32)
+
+        fake = FakeEngine()
+        g = GracefulQueryFn(fake)
+        q = random_points(4, seed=3)
+        d, _ = g.complete(g.dispatch(q))
+        np.testing.assert_array_equal(d, q[:, 0])
+        assert fake.engine_name == "tiled" and g.failures == 1
+
+    def test_stall_accounting_bounds_inflight(self):
+        """With depth 2 and a slow completer, the dispatch worker must stall
+        (bounded occupancy) and record it; occupancy never exceeds depth."""
+        seen_inflight = []
+
+        class SlowEcho:
+            def __init__(self, batcher_ref):
+                self._b = batcher_ref
+
+            def dispatch(self, q):
+                seen_inflight.append(self._b[0].inflight_batches())
+                return q
+
+            def complete(self, q):
+                time.sleep(0.02)
+                return q[:, 0].copy(), np.zeros((len(q), 1), np.int32)
+
+        ref = [None]
+        b = DynamicBatcher(SlowEcho(ref), max_batch=4, max_delay_s=0.001,
+                           pipeline_depth=2)
+        ref[0] = b
+        try:
+            qs = [random_points(4, seed=700 + i) for i in range(8)]
+            out, errs = self._submit_all(b, qs, timeout_s=30)
+            assert all(e is None for e in errs), errs
+            st = b.stats()
+            assert st["dispatch_stalls"] >= 1
+            assert st["dispatch_stall_seconds"] > 0
+            assert b.stall_hist.count >= 1
+            assert max(seen_inflight) <= 2
+        finally:
+            b.shutdown()
+
+
 class TestAdmission:
     def test_rejects_beyond_cap(self):
         a = AdmissionController(max_queue_rows=10)
@@ -406,6 +622,29 @@ class TestHTTPServing:
         assert "knn_request_latency_seconds_bucket" in m
         assert "knn_compile_count" in m
 
+    def test_pipeline_occupancy_in_stats_and_metrics(self, server):
+        """Pipeline occupancy gauges (depth, in-flight batches/rows, stall
+        time) ride /stats and /metrics; the stall histogram shares the
+        loadgen bucket geometry."""
+        base = _url(server)
+        # traffic so the pipelined path has actually run
+        _post(base, {"queries": random_points(5, seed=88).tolist()})
+        stats = json.loads(urllib.request.urlopen(
+            base + "/stats", timeout=10).read())
+        b = stats["batcher"]
+        assert b["pipeline_depth"] == 2 and b["pipelined"] is True
+        for key in ("inflight_batches", "inflight_rows", "dispatch_stalls",
+                    "dispatch_stall_seconds"):
+            assert key in b
+        assert "pipeline_inflight_rows" in stats["admission"]
+        m = urllib.request.urlopen(base + "/metrics",
+                                   timeout=10).read().decode()
+        assert "# TYPE knn_pipeline_depth gauge" in m
+        assert "knn_pipeline_inflight_batches" in m
+        assert "knn_pipeline_dispatch_stalls_total" in m
+        # stall histogram renders even when empty (count 0, +Inf terminal)
+        assert "# TYPE knn_pipeline_stall_seconds histogram" in m
+
     def test_no_recompiles_from_http_traffic(self, server, engine):
         """All the HTTP traffic above rode varied client batch sizes; the
         shape buckets must have absorbed every one of them."""
@@ -497,3 +736,42 @@ class TestLoadgen:
             assert rep[key] > 0
         # the report must be JSON-serializable (it IS the BENCH artifact)
         json.dumps(rep)
+
+    def test_binary_mode_and_server_stats(self, server):
+        """The octet-stream wire format over the keep-alive client, plus
+        the embedded /stats pipeline-occupancy scrape serve_smoke relies
+        on."""
+        import sys
+
+        sys.path.insert(0, "tools")
+        from loadgen import run_load
+
+        rep = run_load(_url(server), duration_s=1.0, concurrency=2, batch=8,
+                       seed=2, binary=True, server_stats=True)
+        assert rep["binary"] is True
+        assert rep["ok"] > 0 and rep["net_error"] == 0
+        s = rep["server"]
+        assert s is not None and s["pipeline_depth"] >= 1
+        assert s["compile_count"] == 4  # binary traffic hit no new bucket
+        json.dumps(rep)
+
+    def test_binary_result_matches_oracle(self, server, index_points):
+        """One keep-alive connection, two sequential binary posts — the
+        socket is reused and both answers are exact."""
+        import sys
+
+        sys.path.insert(0, "tools")
+        from loadgen import _Client
+
+        client = _Client(_url(server), timeout_s=60)
+        try:
+            for seed in (21, 22):
+                q = random_points(6, seed=seed)
+                status, payload = client._request(
+                    "/knn", np.ascontiguousarray(q, np.float32).tobytes(),
+                    "application/octet-stream")
+                assert status == 200
+                got = np.frombuffer(payload, np.float32)
+                assert_dist_equal(got, kth_nn_dist(q, index_points, K))
+        finally:
+            client.close()

@@ -3,4 +3,18 @@
 # exact same gate. Exits with pytest's status; prints DOTS_PASSED for the
 # no-worse-than-seed comparison.
 cd "$(dirname "$0")/.." || exit 1
-set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+
+# Serving bench trajectory (ROADMAP): loadgen q/s + p50/p95/p99 at pipeline
+# depth 1 vs 2 -> BENCH_serve.json, next to the batch BENCH_r*.json series.
+# Runs regardless of the pytest rc (the suite carries known pallas-API-drift
+# failures on the container's jax pin — see ROADMAP), but only reachable
+# when the test step completed rather than timing out (timeout exits 124).
+# Oracle-exactness is the only gate in its exit code; throughput numbers on
+# shared CI boxes are trajectory data, not a pass/fail bar. SERVE_BENCH=0
+# skips (e.g. when iterating on an unrelated subsystem).
+if [ "${SERVE_BENCH:-1}" != "0" ] && [ "$rc" -ne 124 ]; then
+  timeout -k 10 600 python tools/serve_smoke.py --duration 2 --trials 3 \
+      --out BENCH_serve.json >/dev/null || { brc=$?; [ "$rc" -eq 0 ] && rc=$brc; }
+fi
+exit $rc

@@ -16,12 +16,13 @@ client-side and server-side percentiles line up bucket-for-bucket.
 from __future__ import annotations
 
 import argparse
+import http.client
 import json
 import sys
 import threading
 import time
-import urllib.error
 import urllib.request
+from urllib.parse import urlparse
 
 import numpy as np
 
@@ -30,28 +31,111 @@ sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root when run as a file
 from mpi_cuda_largescaleknn_tpu.obs.timers import LatencyHistogram  # noqa: E402
 
 
-def _post_batch(url: str, queries: np.ndarray, timeout_s: float,
-                neighbors: bool) -> int:
-    body = json.dumps({"queries": queries.tolist(),
-                       "neighbors": neighbors}).encode()
-    req = urllib.request.Request(
-        url.rstrip("/") + "/knn", data=body,
-        headers={"Content-Type": "application/json"})
-    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
-        json.loads(resp.read().decode())
-        return resp.status
+class _Client:
+    """One worker's persistent HTTP/1.1 connection to the server.
+
+    The server speaks keep-alive; reusing one socket per worker drops the
+    per-request TCP connect AND the per-connection handler thread the
+    threading server would otherwise spawn — so the measurement (and any
+    real client) pays for kNN, not connection churn. Any transport error
+    tears the socket down and the next request reconnects.
+    """
+
+    def __init__(self, url: str, timeout_s: float):
+        p = urlparse(url if "//" in url else "//" + url)
+        self._https = p.scheme == "https"
+        self._host = p.hostname or "127.0.0.1"
+        self._port = p.port or (443 if self._https else 80)
+        #: URL path prefix, kept so a reverse-proxied server
+        #: (http://host/prefix -> /prefix/knn) still routes
+        self._prefix = p.path.rstrip("/")
+        self._timeout_s = timeout_s
+        self._conn: http.client.HTTPConnection | None = None
+
+    def _request(self, path: str, body: bytes, ctype: str):
+        if self._conn is None:
+            conn_cls = (http.client.HTTPSConnection if self._https
+                        else http.client.HTTPConnection)
+            self._conn = conn_cls(
+                self._host, self._port, timeout=self._timeout_s)
+        path = self._prefix + path
+        try:
+            self._conn.request("POST", path, body=body,
+                               headers={"Content-Type": ctype})
+            resp = self._conn.getresponse()
+            payload = resp.read()  # must drain to reuse the socket
+            return resp.status, payload
+        except Exception:
+            self.close()  # poisoned socket: reconnect on the next request
+            raise
+
+    def close(self):
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            finally:
+                self._conn = None
+
+    def post_batch(self, queries: np.ndarray, neighbors: bool,
+                   binary: bool) -> int:
+        if binary:
+            # raw f32 xyz triples in, raw f32 distances out — the server's
+            # octet-stream format. Skips both sides' JSON encode/decode, so
+            # the client measures the engine, not the text codec (neighbors
+            # ride the query string; only the JSON response carries them)
+            status, payload = self._request(
+                "/knn" + ("?neighbors=1" if neighbors else ""),
+                np.ascontiguousarray(queries, np.float32).tobytes(),
+                "application/octet-stream")
+            if status == 200:
+                np.frombuffer(payload, np.float32)
+            return status
+        status, payload = self._request(
+            "/knn", json.dumps({"queries": queries.tolist(),
+                                "neighbors": neighbors}).encode(),
+            "application/json")
+        json.loads(payload.decode())
+        return status
+
+
+def _server_pipeline_stats(url: str, timeout_s: float) -> dict | None:
+    """Scrape /stats and project the pipeline-occupancy view the report
+    embeds: configured depth, in-flight occupancy, dispatch stalls, mean
+    batch width. None (JSON null) when the server has no /stats."""
+    try:
+        with urllib.request.urlopen(url.rstrip("/") + "/stats",
+                                    timeout=timeout_s) as r:
+            stats = json.loads(r.read().decode())
+    except Exception:  # noqa: BLE001 - stats are optional decoration
+        return None
+    b = stats.get("batcher", {})
+    return {
+        "pipeline_depth": b.get("pipeline_depth"),
+        "pipelined": b.get("pipelined"),
+        "inflight_batches": b.get("inflight_batches"),
+        "inflight_rows": b.get("inflight_rows"),
+        "dispatch_stalls": b.get("dispatch_stalls"),
+        "dispatch_stall_seconds": b.get("dispatch_stall_seconds"),
+        "batches": b.get("batches"),
+        "mean_batch_rows": b.get("mean_batch_rows"),
+        "engine": stats.get("engine", {}).get("engine"),
+        "compile_count": stats.get("engine", {}).get("compile_count"),
+    }
 
 
 def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
              batch: int = 8, qps: float = 0.0, neighbors: bool = False,
              timeout_s: float = 10.0, seed: int = 0,
-             scale: float = 1.0) -> dict:
+             scale: float = 1.0, server_stats: bool = False,
+             binary: bool = False) -> dict:
     """Drive the server; returns the JSON-able report (also the test API).
 
     ``qps > 0`` switches to open loop: the request schedule is fixed at
     ``qps`` requests/s, spread over the workers; a worker that falls behind
     skips ahead (lost sends are counted) rather than silently compressing
-    the offered load.
+    the offered load. ``server_stats`` appends a post-run /stats scrape of
+    the server's pipeline occupancy (depth, stalls, mean batch width) so
+    one artifact carries both sides of a throughput run.
     """
     rng = np.random.default_rng(seed)
     hist = LatencyHistogram()
@@ -73,39 +157,47 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
             else:
                 counts["http_error"] += 1
 
-    def one_request():
+    def one_request(client: _Client):
         q = (rng.random((batch, 3)) * scale).astype(np.float32)
         t0 = time.perf_counter()
         try:
-            status = _post_batch(url, q, timeout_s, neighbors)
-            account(status, time.perf_counter() - t0, batch)
-        except urllib.error.HTTPError as e:
-            account(e.code, time.perf_counter() - t0, 0)
+            status = client.post_batch(q, neighbors, binary)
+            account(status, time.perf_counter() - t0,
+                    batch if status == 200 else 0)
         except Exception:  # noqa: BLE001 - connection refused/reset, timeout
             with lock:
                 counts["net_error"] += 1
 
     def closed_worker():
-        while time.monotonic() < stop_at:
-            one_request()
+        client = _Client(url, timeout_s)
+        try:
+            while time.monotonic() < stop_at:
+                one_request(client)
+        finally:
+            client.close()
 
     def open_worker(wid: int):
         # worker wid owns schedule slots wid, wid+W, wid+2W, ...
+        client = _Client(url, timeout_s)
         interval = concurrency / qps
         next_t = time.monotonic() + (wid / qps)
-        while next_t < stop_at:
-            now = time.monotonic()
-            if now < next_t:
-                time.sleep(next_t - now)
-            elif now - next_t > interval:
-                # behind by a full slot: drop it, keep the offered rate honest
-                missed = int((now - next_t) / interval)
-                next_t += missed * interval
-                with lock:
-                    counts["sched_skipped"] += missed
-                continue
-            one_request()
-            next_t += interval
+        try:
+            while next_t < stop_at:
+                now = time.monotonic()
+                if now < next_t:
+                    time.sleep(next_t - now)
+                elif now - next_t > interval:
+                    # behind by a full slot: drop it, keep the offered rate
+                    # honest
+                    missed = int((now - next_t) / interval)
+                    next_t += missed * interval
+                    with lock:
+                        counts["sched_skipped"] += missed
+                    continue
+                one_request(client)
+                next_t += interval
+        finally:
+            client.close()
 
     t_start = time.monotonic()
     workers = [threading.Thread(
@@ -122,9 +214,11 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
                 ("ok", "overload", "deadline", "http_error"))
     lat = hist.report()
     return {
+        **({"server": _server_pipeline_stats(url, timeout_s)}
+           if server_stats else {}),
         "mode": "open" if qps > 0 else "closed",
         "url": url, "duration_s": round(elapsed, 3),
-        "concurrency": concurrency, "batch": batch,
+        "concurrency": concurrency, "batch": batch, "binary": binary,
         "offered_qps": qps if qps > 0 else None,
         "requests": total, "qps": round(total / elapsed, 2),
         "rows_per_s": round(counts["rows_ok"] / elapsed, 2),
@@ -148,16 +242,21 @@ def main(argv=None) -> int:
     ap.add_argument("--qps", type=float, default=0.0,
                     help=">0: open loop at this offered request rate")
     ap.add_argument("--neighbors", action="store_true")
+    ap.add_argument("--binary", action="store_true",
+                    help="octet-stream bodies (raw f32), not JSON")
     ap.add_argument("--timeout", type=float, default=10.0)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--scale", type=float, default=1.0,
                     help="queries uniform in [0, scale)^3")
+    ap.add_argument("--server-stats", action="store_true",
+                    help="embed a post-run /stats pipeline-occupancy scrape")
     ap.add_argument("--out", default=None, help="write JSON report here")
     a = ap.parse_args(argv)
 
     report = run_load(a.url, duration_s=a.duration, concurrency=a.concurrency,
                       batch=a.batch, qps=a.qps, neighbors=a.neighbors,
-                      timeout_s=a.timeout, seed=a.seed, scale=a.scale)
+                      timeout_s=a.timeout, seed=a.seed, scale=a.scale,
+                      server_stats=a.server_stats, binary=a.binary)
     text = json.dumps(report, indent=2)
     print(text)
     if a.out:

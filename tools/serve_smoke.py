@@ -1,0 +1,215 @@
+#!/usr/bin/env python3
+"""Serving bench smoke: loadgen q/s + p50/p95/p99 at pipeline depth 1 vs 2.
+
+Boots the full serving stack in-process on a CPU fixture (default: one
+virtual device, single-threaded Eigen, tiled engine — one core per
+in-flight program, see _setup_cpu_fixture; --devices 8 matches the tests'
+mesh instead), drives it with tools/loadgen.py closed-loop at each
+requested pipeline depth, and writes a BENCH-series JSON so serving
+throughput regressions are caught like batch ones (the ROADMAP "serving
+bench trajectory" item). One resident engine backs every depth — the shape
+buckets compile once, so the depths differ only in the batcher's
+dispatch/complete overlap, which is the thing being measured.
+
+Each depth's run also posts a fixed probe batch and checks it against the
+brute-force numpy oracle, so the report can assert "pipelined results are
+oracle-exact" next to the throughput numbers it claims for them.
+
+    python tools/serve_smoke.py --duration 3 --out BENCH_serve.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root when run as a file
+
+def _setup_cpu_fixture(devices: int) -> None:
+    """Pin the process to the CPU backend with ``devices`` virtual devices.
+
+    Must run before the first jax import (run_smoke imports jax lazily).
+    Single-threaded Eigen makes one in-flight program cost one core, so
+    pipeline depth maps 1:1 onto compute occupancy: at the default
+    ``devices=1`` a depth-1 server computes on one core while the host
+    side (merge, demux, HTTP) runs beside it, and depth 2 fills the
+    remaining core with the next batch's traversal — the measurable analogue
+    of keeping a TPU's program queue full. ``devices=8`` matches the test
+    fixture's mesh instead (R-way merge exercised, but 8 device threads
+    thrash the small CI boxes' 2 cores — noisy trials).
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if os.environ["JAX_PLATFORMS"] != "cpu":
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags += f" --xla_force_host_platform_device_count={devices}"
+    if devices == 1 and "xla_cpu_multi_thread_eigen" not in flags:
+        # one device -> one core per in-flight program; multi-device meshes
+        # keep Eigen multi-threaded so one program spans the cores the way
+        # one traversal spans a pod's chips
+        flags += " --xla_cpu_multi_thread_eigen=false"
+    os.environ["XLA_FLAGS"] = flags.strip()
+
+
+import numpy as np  # noqa: E402
+
+
+def _run_loadgen(base_url, *, duration_s, concurrency, batch, seed) -> dict:
+    """Drive tools/loadgen.py as a SUBPROCESS: the client's request work
+    must not share this interpreter's GIL with the server's handler,
+    batcher, and merge threads, or the measurement throttles the thing it
+    measures. ``--binary`` for the same reason: raw f32 bodies keep the
+    codec out of the way on both sides, so the run measures the engine
+    pipeline, not JSON."""
+    loadgen = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "loadgen.py")
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as f:
+        out_path = f.name
+    try:
+        subprocess.run(
+            [sys.executable, loadgen, "--url", base_url,
+             "--duration", str(duration_s), "--concurrency", str(concurrency),
+             "--batch", str(batch), "--seed", str(seed), "--server-stats",
+             "--binary", "--out", out_path],
+            check=True, stdout=subprocess.DEVNULL, timeout=duration_s + 120)
+        with open(out_path) as f:
+            return json.load(f)
+    finally:
+        os.unlink(out_path)
+
+
+def _probe_oracle_exact(base_url, points, k, seed) -> bool:
+    """POST a fixed batch through the live (possibly pipelined) server and
+    compare against brute force — <=2 ulp, the tests' engine-vs-numpy bar
+    (tests/oracle.py is the one ground-truth implementation)."""
+    from tests.oracle import kth_nn_dist
+
+    rng = np.random.default_rng(seed)
+    q = rng.random((64, 3)).astype(np.float32)
+    body = json.dumps({"queries": q.tolist()}).encode()
+    req = urllib.request.Request(
+        base_url + "/knn", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        got = np.asarray(json.loads(resp.read())["dists"], np.float32)
+    want = kth_nn_dist(q, points, k)
+    return bool(np.allclose(got, want, rtol=5e-7, atol=1e-37))
+
+
+def run_smoke(*, n_points=8192, k=16, depths=(1, 2), duration_s=3.0,
+              concurrency=8, batch=64, max_batch=128, max_delay_s=0.008,
+              trials=3, devices=1, seed=0) -> dict:
+    _setup_cpu_fixture(devices)
+    from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+    from mpi_cuda_largescaleknn_tpu.serve.engine import ResidentKnnEngine
+    from mpi_cuda_largescaleknn_tpu.serve.server import build_server
+
+    rng = np.random.default_rng(seed)
+    points = rng.random((n_points, 3)).astype(np.float32)
+    engine = ResidentKnnEngine(points, k, mesh=get_mesh(devices),
+                               engine="tiled", bucket_size=64,
+                               max_batch=max_batch, min_batch=16)
+    t0 = time.perf_counter()
+    engine.warmup()
+    warmup_s = time.perf_counter() - t0
+
+    def one_trial(depth, trial):
+        srv = build_server(engine, port=0, max_delay_s=max_delay_s,
+                           pipeline_depth=depth)
+        srv.ready = True
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        try:
+            exact = _probe_oracle_exact(base, points, k, seed)
+            rep = _run_loadgen(base, duration_s=duration_s,
+                               concurrency=concurrency, batch=batch,
+                               seed=seed + trial)
+            rep["oracle_exact"] = exact
+            return rep
+        finally:
+            srv.close()
+
+    # throwaway warmup pass: the first load the process serves runs cold
+    # (page cache, JIT-internal caches, thread spin-up) and lands on
+    # whichever depth goes first — burn that on a run nobody scores
+    one_trial(depths[0], trials)
+
+    # interleave trials (1, 2, 1, 2, ...) and take per-depth MEDIAN q/s:
+    # on a small shared box one run's noise (CPU steal, page cache) easily
+    # exceeds the effect; interleaving spreads it evenly across depths
+    runs: dict[str, list[dict]] = {str(d): [] for d in depths}
+    for trial in range(trials):
+        for depth in depths:
+            runs[str(depth)].append(one_trial(depth, trial))
+
+    per_depth: dict[str, dict] = {}
+    for key, reps in runs.items():
+        med = sorted(reps, key=lambda r: r["qps"])[len(reps) // 2]
+        per_depth[key] = {
+            **med,
+            "qps_trials": [r["qps"] for r in reps],
+            "oracle_exact": all(r["oracle_exact"] for r in reps),
+        }
+
+    out = {
+        "kind": "serve_smoke",
+        "n_points": n_points, "k": k, "devices": devices,
+        "engine": engine.engine_name,
+        "compile_count": engine.compile_count, "warmup_s": round(warmup_s, 3),
+        "duration_s": duration_s, "concurrency": concurrency, "batch": batch,
+        "trials": trials, "per_depth": per_depth,
+    }
+    d1, d2 = per_depth.get("1"), per_depth.get("2")
+    if d1 and d2 and d1["qps"]:
+        out["qps_speedup_depth2_vs_1"] = round(d2["qps"] / d1["qps"], 3)
+        if d1["p99_ms"] and d2["p99_ms"]:
+            out["p99_ratio_depth2_vs_1"] = round(
+                d2["p99_ms"] / d1["p99_ms"], 3)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--points", type=int, default=8192)
+    ap.add_argument("--k", type=int, default=16)
+    ap.add_argument("--depths", default="1,2",
+                    help="comma-separated pipeline depths to bench")
+    ap.add_argument("--duration", type=float, default=3.0,
+                    help="seconds of closed-loop load per depth")
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--trials", type=int, default=3,
+                    help="interleaved trials per depth; median q/s reported")
+    ap.add_argument("--devices", type=int, default=1,
+                    help="virtual CPU devices / index shards")
+    ap.add_argument("--max-delay-ms", type=float, default=8.0,
+                    help="batcher flush deadline (docs/TUNING.md)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=None, help="write JSON report here")
+    a = ap.parse_args(argv)
+
+    report = run_smoke(n_points=a.points, k=a.k,
+                       depths=tuple(int(d) for d in a.depths.split(",")),
+                       duration_s=a.duration, concurrency=a.concurrency,
+                       batch=a.batch, trials=a.trials, devices=a.devices,
+                       max_delay_s=a.max_delay_ms / 1e3, seed=a.seed)
+    text = json.dumps(report, indent=2)
+    print(text)
+    if a.out:
+        with open(a.out, "w") as f:
+            f.write(text + "\n")
+    ok = all(r.get("oracle_exact") for r in report["per_depth"].values())
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
