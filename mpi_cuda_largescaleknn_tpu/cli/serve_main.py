@@ -5,8 +5,9 @@ builds the resident sharded index, AOT-compiles every shape bucket, and
 serves queries over HTTP until killed:
 
     python -m mpi_cuda_largescaleknn_tpu.cli.serve_main points.float3 -k 100 \
-        [--port 8080] [--engine auto] [--shards R] [--max-batch 1024] \
-        [--max-delay-ms 2] [--max-queue-rows 4096] [--timeout-ms 5000]
+        [--port 8080] [--engine auto] [--merge auto] [--shards R] \
+        [--max-batch 1024] [--max-delay-ms 2] [--max-queue-rows 4096] \
+        [--timeout-ms 5000]
 
 Endpoints: POST /knn (JSON or binary), GET /healthz, /stats, /metrics
 (Prometheus text). See docs/SERVING.md and tools/loadgen.py.
@@ -30,6 +31,11 @@ SERVE_FLAGS = """
   --port P          HTTP port (default 8080; 0 = pick a free port)
   --host H          bind address (default 127.0.0.1)
   --engine E        tiled | pallas_tiled | bruteforce | auto (default auto)
+  --merge M         host | device | auto (default auto): where the R-way
+                    cross-shard top-k merge runs — device keeps it inside
+                    the SPMD program (all_to_all reduce-scatter + top_k;
+                    one final [Q,k] fetch, no numpy merge), host fetches
+                    R partials; auto = device on power-of-two meshes
   --shards N        size of the 1-D device mesh (default: all devices)
   --bucket-size N   points per spatial bucket (0 = engine-tuned auto)
   --max-batch N     widest padded query batch / shape bucket (default 1024)
@@ -55,7 +61,8 @@ def usage(error: str) -> "NoReturn":  # noqa: F821
 
 def parse_serve_args(argv: list[str]) -> dict:
     opt = {"k": 0, "max_radius": math.inf, "in_path": "", "port": 8080,
-           "host": "127.0.0.1", "engine": "auto", "shards": None,
+           "host": "127.0.0.1", "engine": "auto", "merge": "auto",
+           "shards": None,
            "bucket_size": 0, "max_batch": 1024, "min_batch": 8,
            "max_delay_ms": 2.0, "pipeline_depth": 2,
            "max_queue_rows": 4096,
@@ -77,6 +84,8 @@ def parse_serve_args(argv: list[str]) -> dict:
                 i += 1; opt["host"] = argv[i]
             elif arg == "--engine":
                 i += 1; opt["engine"] = argv[i]
+            elif arg == "--merge":
+                i += 1; opt["merge"] = argv[i]
             elif arg == "--shards":
                 i += 1; opt["shards"] = int(argv[i])
             elif arg == "--bucket-size":
@@ -129,7 +138,7 @@ def main(argv: list[str] | None = None) -> int:
         points, opt["k"], mesh=get_mesh(opt["shards"]),
         engine=opt["engine"], bucket_size=opt["bucket_size"],
         max_radius=opt["max_radius"], max_batch=opt["max_batch"],
-        min_batch=opt["min_batch"])
+        min_batch=opt["min_batch"], merge=opt["merge"])
     server = build_server(
         engine, host=opt["host"], port=opt["port"],
         max_delay_s=opt["max_delay_ms"] / 1e3,

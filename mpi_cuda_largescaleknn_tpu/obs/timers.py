@@ -122,6 +122,9 @@ class PhaseTimers:
     phases: dict[str, PhaseRecord] = field(default_factory=dict)
     histograms: dict[str, LatencyHistogram] = field(default_factory=dict)
     gauges: dict[str, float] = field(default_factory=dict)
+    counters: dict[str, int] = field(default_factory=dict)
+    _counter_lock: threading.Lock = field(default_factory=threading.Lock,
+                                          repr=False)
 
     @contextlib.contextmanager
     def phase(self, name: str, bytes_moved: int = 0):
@@ -149,6 +152,17 @@ class PhaseTimers:
         semantics; no lock needed."""
         self.gauges[name] = float(value)
 
+    def count(self, name: str, by: int = 1) -> None:
+        """Monotonic counter (e.g. ``fetch_bytes``, ``result_rows``) —
+        unlike gauges, increments from concurrent completion threads must
+        not lose updates, hence the lock."""
+        with self._counter_lock:
+            self.counters[name] = self.counters.get(name, 0) + int(by)
+
+    def counter(self, name: str) -> int:
+        with self._counter_lock:
+            return self.counters.get(name, 0)
+
     def report(self) -> dict:
         # list() snapshots: a serving /stats scrape may race a worker thread
         # inserting a new phase or histogram mid-iteration
@@ -159,6 +173,8 @@ class PhaseTimers:
             out[name] = h.report()
         for name, v in list(self.gauges.items()):
             out[name] = v
+        with self._counter_lock:
+            out.update(self.counters)
         return out
 
     def dump(self) -> str:

@@ -66,6 +66,51 @@ def merge_candidates(state: CandidateState, cand_dist2: jnp.ndarray,
     return CandidateState(sorted_d2[:, :k], sorted_idx[:, :k])
 
 
+def tree_merge_candidates(state: CandidateState, axis: str,
+                          num_shards: int) -> CandidateState:
+    """Cross-shard top-k all-reduce inside ``shard_map``: every device ends
+    with the global top-k of the ``num_shards`` per-shard candidate states.
+
+    log2(R) recursive-doubling rounds: in round s each device exchanges its
+    running state with the device whose index differs in bit s (one
+    ``ppermute`` whose permutation is its own inverse — both directions of
+    every link carry state simultaneously, the same full-duplex discipline
+    as the ring's counter-rotating copies) and folds the arriving state
+    through ``merge_candidates``. Operand order is the whole tie contract:
+    the state covering the LOWER shard-index block is always the left
+    (existing) operand, so ``merge_candidates``' stable sort resolves equal
+    distances in ascending (shard, slot) order — bit-identical to the host
+    merge's stable argsort over shard-major concatenated candidate rows
+    (serve/engine.py ``_merge_shard_candidates``).
+
+    Truncation to k per round loses nothing: any global top-k entry is in
+    the top-k of every union that contains it. Requires power-of-two
+    ``num_shards`` (the recursive-doubling blocks must tile the axis;
+    ``resolve_merge`` in parallel/ring.py falls back to the host merge
+    otherwise). R == 1 is the identity.
+    """
+    if num_shards & (num_shards - 1):
+        raise ValueError(
+            f"tree merge needs a power-of-two shard count, got {num_shards}")
+    me = jax.lax.axis_index(axis)
+    step = 1
+    while step < num_shards:
+        perm = [(i, i ^ step) for i in range(num_shards)]
+        other_d2 = jax.lax.ppermute(state.dist2, axis, perm)
+        other_idx = jax.lax.ppermute(state.idx, axis, perm)
+        # my current block is [me & ~(2*step - 1), +step) or the one above:
+        # bit s of the device index says which; the lower block merges first
+        mine_lower = (me & step) == 0
+        first = CandidateState(
+            jnp.where(mine_lower, state.dist2, other_d2),
+            jnp.where(mine_lower, state.idx, other_idx))
+        second_d2 = jnp.where(mine_lower, other_d2, state.dist2)
+        second_idx = jnp.where(mine_lower, other_idx, state.idx)
+        state = merge_candidates(first, second_d2, second_idx)
+        step <<= 1
+    return state
+
+
 def extract_final_result(state: CandidateState) -> jnp.ndarray:
     """k-th-NN distance per query: ``sqrt(kth smallest dist2)``; stays ``inf``
     when fewer than k neighbors were found (reference

@@ -159,6 +159,103 @@ def resolve_engine(engine: str) -> str:
     return "pallas_tiled"
 
 
+def resolve_merge(merge: str, num_shards: int) -> str:
+    """Resolve the cross-shard top-k merge placement.
+
+    ``device`` keeps the R-way reduction inside the SPMD program
+    (``device_merge_final``: an ``all_to_all`` reduce-scatter by default,
+    the log2(R) ``ppermute`` tree of ops/candidates.py
+    ``tree_merge_candidates`` as the all-reduce form), so the host fetches
+    one final [Q, k] result instead of R partial ones; ``host`` fetches all R
+    partials and merges them in numpy. ``auto`` picks ``device`` whenever
+    the reduction is available — every power-of-two mesh — and falls back
+    to ``host`` otherwise (recursive doubling needs the blocks to tile the
+    axis). Results are bit-identical either way (same tie discipline); the
+    choice is pure data movement. An explicit ``device`` on a
+    non-power-of-two mesh raises rather than silently degrading.
+    """
+    if merge == "auto":
+        return "device" if num_shards & (num_shards - 1) == 0 else "host"
+    if merge == "device":
+        if num_shards & (num_shards - 1):
+            raise ValueError(
+                f"merge='device' needs a power-of-two shard count, got "
+                f"{num_shards} (use merge='auto' to fall back to host)")
+        return "device"
+    if merge == "host":
+        return "host"
+    raise ValueError(f"unknown merge mode '{merge}' "
+                     "(expected host | device | auto)")
+
+
+def device_merge_final(heap: CandidateState, num_shards: int,
+                       via: str = "a2a"):
+    """Device-side finale of a replicate-traverse-merge program (inside
+    ``shard_map``): reduce the R per-shard candidate states for the SAME
+    replicated queries to the global top-k and have each device emit its
+    1/R row-slice of the final answer — the stitched global arrays are
+    exactly [Q] dists / [Q, k] candidates, so the host fetch shrinks R x
+    (the reference materializes once per run for the same reason,
+    unorderedDataVariant.cu extractFinalResult; here it is per batch).
+
+    Two reductions, bit-identical outputs:
+
+    - ``a2a`` (default): a reduce-scatter — ONE ``all_to_all`` hands every
+      device all R shards' candidate blocks for only ITS 1/R rows
+      (shard-major), then a single width-R*k ``top_k`` finishes. ``top_k``
+      prefers the lower column at equal (negated) keys, which over
+      shard-major columns IS the host merge's stable tie discipline
+      (earlier shard, then earlier slot — verified against
+      ``np.argsort(kind="stable")`` in tests). Moves (R-1)/R of each
+      state once and sorts each row once: less traffic AND less sort work
+      than the tree, and ~30x faster on XLA:CPU, whose row-sort emits a
+      scalar comparator loop while its TopK is a tuned custom call.
+    - ``tree``: the log2(R) ``ppermute`` recursive-doubling all-reduce
+      (ops/candidates.py ``tree_merge_candidates``) followed by a slice —
+      every device transiently holds the FULL merged state, the building
+      block the multi-host front end's cross-host level wants.
+
+    Returns (dists, dist2, idx) of ``Q // num_shards`` rows; Q must be
+    divisible by num_shards (callers pad the batch to a bucket that is).
+    Unused outputs are dead-code-eliminated by XLA, so callers that only
+    fetch (dists, idx) pay nothing for the dist2 slice.
+    """
+    from mpi_cuda_largescaleknn_tpu.ops.candidates import (
+        tree_merge_candidates,
+    )
+
+    rows, k = heap.dist2.shape
+    if rows % num_shards:
+        raise ValueError(f"{rows} query rows do not tile {num_shards} "
+                         "shards (pad the batch to a multiple)")
+    rp = rows // num_shards
+    if num_shards == 1:
+        return extract_final_result(heap), heap.dist2, heap.idx
+    if via == "tree":
+        st = tree_merge_candidates(heap, AXIS, num_shards)
+        off = jax.lax.axis_index(AXIS) * rp
+        return (jax.lax.dynamic_slice_in_dim(extract_final_result(st),
+                                             off, rp),
+                jax.lax.dynamic_slice_in_dim(st.dist2, off, rp),
+                jax.lax.dynamic_slice_in_dim(st.idx, off, rp))
+    if via != "a2a":
+        raise ValueError(f"unknown device merge reduction '{via}'")
+
+    def scatter(x):
+        # [Q, k] -> [R*rp, k]: block j holds shard j's candidates for MY
+        # rp rows -> [rp, R*k] with columns in shard-major order
+        x = jax.lax.all_to_all(x, AXIS, 0, 0, tiled=True)
+        return x.reshape(num_shards, rp, k).transpose(1, 0, 2).reshape(
+            rp, num_shards * k)
+
+    cat_d2 = scatter(heap.dist2)
+    cat_idx = scatter(heap.idx)
+    neg, cols = jax.lax.top_k(-cat_d2, k)
+    top_d2 = -neg  # -(-0.0) == 0.0, -(-inf) == inf: values round-trip
+    top_idx = jnp.take_along_axis(cat_idx, cols, axis=1)
+    return jnp.sqrt(top_d2[:, k - 1]), top_d2, top_idx
+
+
 def resolve_bucket_size(bucket_size: int, engine: str) -> int:
     """0 = auto, resolved per engine from measured data: the XLA twin is
     pair-budget-bound on its low-overhead backend (CPU wall-clock tracks
@@ -717,6 +814,7 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
                      checkpoint_every: int = 1,
                      max_chunks: int | None = None,
                      pipeline_depth: int = 2,
+                     merge: str = "host",
                      return_candidates: bool = False,
                      return_stats: bool = False):
     """``ring_knn`` with the query side streamed in fixed-size chunks.
@@ -750,6 +848,28 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
     double-buffering cost. A due checkpoint forces a full drain first, so
     snapshots only ever record fully materialized chunks.
 
+    Merge placement (``merge``, default ``host``): ``host`` is the ring —
+    per chunk, tree shards rotate R times past stationary per-device query
+    heaps, and each device's heap ends global with no cross-shard merge at
+    all. ``device`` replaces the rotation with the serving engine's
+    replicate-traverse-merge shape: the whole chunk is REPLICATED to every
+    device, each traverses only its own resident shard (zero ``ppermute``
+    rotations of tree data, one program dispatch per chunk instead of
+    R//2+1 stepped rounds), and the R partial candidate states reduce to
+    the final answer in-program (``device_merge_final``'s reduce-scatter)
+    before ``extract_final_result`` — the deferred per-chunk fetch then
+    carries final rows only. Result and candidate DISTANCES are bit-identical to
+    the ring's; at equal distances the two strategies order neighbor ids
+    differently (the ring in fold-arrival order — own shard first, per
+    device — the device merge in ascending (shard, slot) order, the
+    serving engine's discipline), both exact top-k. The trade: candidate states
+    hold ALL R*chunk_rows chunk queries per device (R x the ring's heap
+    memory) and the queries ride one coarse prune bucket, so device merge
+    wins at SMALL chunks — the round-dispatch-bound regime — while the
+    ring's fine-bucketed prune wins large ones. ``auto`` resolves like the
+    engine's (``resolve_merge``: device on power-of-two meshes);
+    single-host only.
+
     Returns like ``ring_knn``: f32[R*Npad] shard-major distances (numpy),
     plus (dist2, idx) candidate arrays when ``return_candidates``.
     """
@@ -759,6 +879,8 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
     engine = resolve_engine(engine)
     bucket_size = resolve_bucket_size(bucket_size, engine)
     num_shards = mesh.shape[AXIS]
+    merge_requested = merge
+    merge = resolve_merge(merge, num_shards)
     _init, round_fn, final_fn, shard_init_fn, query_init_fn, _ifq, \
         query_from_q = _make_ring_fns(
             k, max_radius, engine, query_tile, point_tile, bucket_size,
@@ -773,6 +895,14 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
     # no host could hold at reference scale
     multi = jax.process_count() > 1
     if multi:
+        if merge == "device":
+            if merge_requested == "auto":
+                merge = "host"  # auto keeps the working ring path
+            else:
+                raise ValueError(
+                    "merge='device' chunked runs are single-host for now — "
+                    "the multi-host front end consumes the same reduction "
+                    "at the cross-host level (ROADMAP: multi-host serving)")
         if not isinstance(points_sharded, jax.Array):
             raise ValueError("multi-host chunked ring needs global sharded "
                              "jax.Arrays (see cli/multihost.py)")
@@ -857,6 +987,45 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
     total_rounds = ring_total_rounds(num_shards)
     rnd0 = to_global(np.zeros(n_my, np.int32), num_shards)
 
+    use_tiled = query_from_q is not None
+    if merge == "device":
+        # replicate-traverse-merge chunk program (one dispatch per chunk):
+        # the replicated chunk traverses each device's OWN resident shard,
+        # the R partial candidate states tree-reduce in-program, and each
+        # device emits its 1/R slice of the final rows — same global row
+        # layout as the ring path, so drain/checkpoint logic is shared
+        qrows = num_shards * chunk_rows
+        flat_update = (None if use_tiled
+                       else _engine_fn(engine, query_tile, point_tile))
+        tiled_update_m = _tiled_engine_fn(engine) if use_tiled else None
+        rep_sharding = NamedSharding(mesh, P())
+
+        def merge_body(*args):
+            q, shard = args[-1], args[:-1]
+            heap = pvary(init_candidates(qrows, k, max_radius))
+            if use_tiled:
+                valid = q[:, 0] < PAD_SENTINEL / 2
+                qids = jnp.where(valid,
+                                 jnp.arange(qrows, dtype=jnp.int32), -1)
+                qlo = jnp.min(jnp.where(valid[:, None], q, jnp.inf), axis=0)
+                qhi = jnp.max(jnp.where(valid[:, None], q, -jnp.inf), axis=0)
+                qb = BucketedPoints(q[None], qids[None], qlo[None],
+                                    qhi[None], qids[None])
+                resident = BucketedPoints(shard[0], shard[1], shard[2],
+                                          shard[3], shard[1])
+                st, tiles = tiled_update_m(heap, qb, resident,
+                                           with_stats=True)
+            else:
+                st = flat_update(heap, q, *shard)
+                tiles = pvary(jnp.zeros((), jnp.int32))
+            dists, d2f, idxf = device_merge_final(st, num_shards)
+            return dists, d2f, idxf, tiles[None]
+
+        merge_prog = jax.jit(jax.shard_map(
+            merge_body, mesh=mesh,
+            in_specs=(spec,) * (4 if use_tiled else 2) + (P(),),
+            out_specs=(spec, spec, spec, spec), check_vma=check_vma))
+
     out_d = np.full((n_my, npad_local), np.inf, np.float32)
     out_hd2 = (np.full((n_my, npad_local, k), np.inf, np.float32)
                if return_candidates else None)
@@ -880,6 +1049,10 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
             bucket_size=bucket_size, chunk_rows=chunk_rows,
             query_tile=query_tile, point_tile=point_tile,
             candidates=bool(return_candidates),
+            # key present only for device merge: host-merge checkpoints
+            # written before the knob existed stay resumable (results are
+            # bit-identical across modes, but resuming records the plan)
+            **({"merge": merge} if merge == "device" else {}),
             my_pos=",".join(str(s) for s in my_pos),
             data=ckpt.data_digest(
                 np.concatenate([pts_b[s].reshape(-1) for s in my_pos]),
@@ -901,8 +1074,9 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
 
     def stage(c):
         # host staging for chunk c: sentinel-pad, upload, dispatch the query
-        # partition + heap init. Everything device-side here is async
-        # dispatch, so staging chunk c+1 overlaps chunk c's in-flight rounds
+        # partition + heap init (ring) or the replicated chunk upload
+        # (device merge). Everything device-side here is async dispatch, so
+        # staging chunk c+1 overlaps chunk c's in-flight work
         lo = c * chunk_rows
         hi = min(lo + chunk_rows, npad_local)
         qp = np.full((n_my, chunk_rows, 3), PAD_SENTINEL, np.float32)
@@ -910,6 +1084,11 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
         for j, s in enumerate(my_pos):
             qp[j, :hi - lo] = pts_b[s][lo:hi]
             qi[j, :hi - lo] = ids_b[s][lo:hi]
+        if merge == "device":
+            # ids stay host-side: result neighbor ids come from the
+            # resident shard, and validity rides the sentinel coordinates
+            return lo, hi, jax.device_put(qp.reshape(-1, 3),
+                                          rep_sharding), None
         stationary, heap = qinit(
             to_global(qp.reshape(-1, 3), num_shards * chunk_rows),
             to_global(qi.reshape(-1), num_shards * chunk_rows))
@@ -929,18 +1108,25 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
     for c in range(start_chunk, stop_chunk):
         lo, hi, stationary, heap = staged
         chunks_run += 1
-        # pristine pair each chunk: the resident original never rotates, so
-        # the traveling copies can be discarded wherever the sweep ends
-        pair = (shard0, shard0)
-        rnd_arr = rnd0
-        for _r in range(total_rounds):
-            fn = step_last if _r == total_rounds - 1 else step
-            f_state, b_state, heap, tiles, rnd_arr = fn(
-                stationary, pair[0], pair[1], heap, rnd_arr)
-            pair = (f_state, b_state)
+        if merge == "device":
+            # one dispatch: traverse own shard, tree-reduce, slice final
+            d, hd2, hidx, tiles = merge_prog(*shard0, stationary)
             if return_stats:
                 tiles_parts.append(tiles)
-        d, hd2, hidx = final(stationary, heap)
+        else:
+            # pristine pair each chunk: the resident original never
+            # rotates, so the traveling copies can be discarded wherever
+            # the sweep ends
+            pair = (shard0, shard0)
+            rnd_arr = rnd0
+            for _r in range(total_rounds):
+                fn = step_last if _r == total_rounds - 1 else step
+                f_state, b_state, heap, tiles, rnd_arr = fn(
+                    stationary, pair[0], pair[1], heap, rnd_arr)
+                pair = (f_state, b_state)
+                if return_stats:
+                    tiles_parts.append(tiles)
+            d, hd2, hidx = final(stationary, heap)
         pending.append((lo, hi, d, hd2, hidx))
         # drain down to depth-1 pending BEFORE staging the next chunk: at
         # depth 1 that is exactly the serialized loop (fetch, then stage —
@@ -992,11 +1178,19 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
                                out_idx.reshape(-1, k)),)
     if return_stats:
         tiles_total = int(np.sum([np.asarray(t).sum() for t in tiles_parts]))
-        out += (_ring_stats(
-            engine, tiles_total, bucket_size,
-            chunks_run * num_shards * num_shards * chunk_rows * npad_local,
-            q_rows=chunk_rows, p_rows=npad_local,
-            point_group=point_group),)
+        if merge == "device" and use_tiled:
+            # device-merge tiles span the chunk's single query bucket
+            # (R*chunk_rows rows), not the ring's fine query buckets
+            _, s_p = choose_buckets(npad_local, bucket_size)
+            out += ({"pair_evals": tiles_total * num_shards * chunk_rows
+                     * s_p * point_group,
+                     "tiles": tiles_total, "flops_per_pair": 8},)
+        else:
+            out += (_ring_stats(
+                engine, tiles_total, bucket_size,
+                chunks_run * num_shards * num_shards * chunk_rows
+                * npad_local, q_rows=chunk_rows, p_rows=npad_local,
+                point_group=point_group),)
     return out if len(out) > 1 else out[0]
 
 

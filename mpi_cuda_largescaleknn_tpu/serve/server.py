@@ -148,6 +148,14 @@ class _Handler(BaseHTTPRequestHandler):
         lines = []
         for name, val in srv.metrics.counters.items():
             lines += [f"# TYPE {name} counter", f"{name} {val}"]
+        # engine-side cumulative counters: bytes fetched across the host
+        # link and result rows completed — the device-vs-host merge
+        # placement shows up as fetch_bytes/result_rows shrinking ~R x
+        for name, val in (("knn_fetch_bytes_total", e["fetch_bytes"]),
+                          ("knn_result_rows_total", e["result_rows"])):
+            lines += [f"# TYPE {name} counter", f"{name} {val}"]
+        lines += ["# TYPE knn_merge_mode gauge",
+                  f'knn_merge_mode{{mode="{e["merge"]}"}} 1']
         gauges = {
             "knn_ready": int(srv.ready),
             "knn_engine_degraded": int(e["degraded_reason"] is not None),

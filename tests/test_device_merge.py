@@ -1,0 +1,455 @@
+"""Device-side cross-shard top-k merge: the ISSUE-3 exactness contract.
+
+The device merge (ops/candidates.py ``tree_merge_candidates`` + the
+engine's / chunked driver's ``merge="device"`` programs) must be
+BIT-IDENTICAL to the host merge — distances, neighbor indices, and
+equal-distance tie-breaks — across shard counts, ragged/padded batches,
+and duplicate-heavy point sets. These tests are the proof; the host merge's
+argpartition rewrite is held to the same standard against the stable
+argsort it replaced.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mpi_cuda_largescaleknn_tpu.serve.engine import (
+    ResidentKnnEngine,
+    _merge_shard_candidates,
+)
+from tests.oracle import assert_dist_equal, kth_nn_dist, random_points
+
+K = 4
+
+
+def _dup_points(n, seed):
+    """Point set with heavy exact duplicates spread across slab shards:
+    equal-distance candidates with DIFFERENT global ids exist for nearly
+    every query, so any tie-discipline divergence between merge placements
+    shows up as a neighbor-id mismatch."""
+    base = random_points(max(n // 4, 8), seed=seed)
+    reps = -(-n // len(base))
+    return np.tile(base, (reps, 1))[:n].copy()
+
+
+def _engine_pair(points, r, **kw):
+    from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+
+    mesh = get_mesh(r)
+    args = dict(engine="tiled", bucket_size=32, max_batch=32, min_batch=16)
+    args.update(kw)
+    return (ResidentKnnEngine(points, K, mesh=mesh, merge="host", **args),
+            ResidentKnnEngine(points, K, mesh=mesh, merge="device", **args))
+
+
+class TestDeviceMergeEqualsHostMerge:
+    @pytest.mark.parametrize("r", [1, 2, 4, 8])
+    def test_property_across_shard_counts(self, r):
+        """The acceptance bar: device merge == host merge bit-for-bit —
+        distances, neighbor ids, and tie order — at R in {1, 2, 4, 8},
+        with duplicate points forcing cross-shard distance ties and ragged
+        batch sizes forcing padded sentinel query rows."""
+        points = _dup_points(600, seed=r)
+        host, dev = _engine_pair(points, r)
+        assert host.merge_mode == "host" and dev.merge_mode == "device"
+        for n in (1, 5, 16, 17, 32):  # ragged sizes pad up to 16/32 buckets
+            q = random_points(n, seed=100 * r + n)
+            q[: n // 2] = points[: n // 2]  # query ON duplicated points:
+            dh, nh = host.query(q)         # distance-0 ties included
+            dd, nd = dev.query(q)
+            np.testing.assert_array_equal(dh, dd)
+            np.testing.assert_array_equal(nh, nd)
+            assert_dist_equal(dd, kth_nn_dist(q, points, K))
+
+    def test_bruteforce_engine_matches_too(self):
+        points = _dup_points(300, seed=9)
+        host, dev = _engine_pair(points, 8, engine="bruteforce")
+        q = random_points(20, seed=5)
+        dh, nh = host.query(q)
+        dd, nd = dev.query(q)
+        np.testing.assert_array_equal(dh, dd)
+        np.testing.assert_array_equal(nh, nd)
+
+    def test_max_radius_underfull_rows_match(self):
+        """Under-full heaps (max_radius cutoff): the untouched r^2 / -1
+        slots tie across every shard — the all-sentinel tie case."""
+        points = random_points(400, seed=3)
+        host, dev = _engine_pair(points, 4, max_radius=0.05)
+        q = random_points(24, seed=7)
+        dh, nh = host.query(q)
+        dd, nd = dev.query(q)
+        np.testing.assert_array_equal(dh, dd)
+        np.testing.assert_array_equal(nh, nd)
+
+    def test_fetch_bytes_shrink_by_shard_count(self):
+        """complete() under device merge fetches one final [Q] + [Q, k]
+        instead of R x [Q, k] partial pairs: >= R x fewer bytes."""
+        points = random_points(500, seed=1)
+        host, dev = _engine_pair(points, 8)
+        q = random_points(32, seed=2)
+        host.query(q)
+        dev.query(q)
+        hb = host.stats()["fetch_bytes"]
+        db = dev.stats()["fetch_bytes"]
+        assert hb >= 8 * db, (hb, db)
+        assert host.stats()["result_rows"] == dev.stats()["result_rows"] == 32
+
+    def test_compile_count_discipline_per_merge_mode(self):
+        """Device-merge programs live in their own AOT shape buckets: warmup
+        compiles exactly one program per bucket, traffic across every
+        ragged size adds zero."""
+        points = random_points(400, seed=4)
+        _, dev = _engine_pair(points, 8)
+        dev.warmup()
+        warm = dev.compile_count
+        assert warm == len(dev.shape_buckets)
+        for n in (1, 3, 16, 17, 31, 32):
+            dev.query(random_points(n, seed=n))
+        assert dev.compile_count == warm
+
+    def test_min_batch_bumped_to_tile_mesh(self):
+        """Device merge slices the final result 1/R per device, so shape
+        buckets must be >= num_shards; the engine bumps min_batch."""
+        from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+
+        eng = ResidentKnnEngine(random_points(200, seed=1), K,
+                                mesh=get_mesh(8), engine="tiled",
+                                bucket_size=32, max_batch=32, min_batch=2,
+                                merge="device")
+        assert eng.shape_buckets[0] >= 8
+
+
+class TestResolveMerge:
+    def test_auto_prefers_device_on_pow2(self):
+        from mpi_cuda_largescaleknn_tpu.parallel.ring import resolve_merge
+
+        assert resolve_merge("auto", 8) == "device"
+        assert resolve_merge("auto", 1) == "device"
+        assert resolve_merge("auto", 6) == "host"
+        assert resolve_merge("host", 8) == "host"
+        with pytest.raises(ValueError, match="power-of-two"):
+            resolve_merge("device", 6)
+        with pytest.raises(ValueError, match="unknown merge"):
+            resolve_merge("gpu", 8)
+
+    def test_engine_auto_on_non_pow2_mesh_falls_back(self):
+        from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+
+        eng = ResidentKnnEngine(random_points(200, seed=1), K,
+                                mesh=get_mesh(3), engine="tiled",
+                                bucket_size=32, max_batch=16, min_batch=16)
+        assert eng.merge_mode == "host"
+        d, _ = eng.query(random_points(6, seed=2))
+        assert d.shape == (6,)
+
+    def test_chunked_auto_on_multi_host_falls_back(self, monkeypatch):
+        """merge='auto' under multi-host takes the ring path instead of
+        crashing on the single-host guard (explicit device still raises
+        — covered in TestChunkedDeviceMerge)."""
+        import jax
+
+        from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+        from mpi_cuda_largescaleknn_tpu.parallel.ring import ring_knn_chunked
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        # falls through to the multi-host input validation (host path),
+        # not the merge='device' single-host error
+        with pytest.raises(ValueError, match="global sharded"):
+            ring_knn_chunked(np.zeros((64, 3), np.float32),
+                             np.zeros(64, np.int32), K, get_mesh(8),
+                             chunk_rows=8, merge="auto")
+
+
+class TestTreeMergeKernel:
+    def test_tree_merge_equals_host_merge_on_synthetic_ties(self):
+        """tree_merge_candidates under shard_map vs the host stable merge
+        on hand-built per-shard candidate rows riddled with cross-shard
+        ties: the reduction must pick the SAME winners in the SAME order
+        (earlier shard, then earlier slot, at equal distance)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from mpi_cuda_largescaleknn_tpu.core.types import CandidateState
+        from mpi_cuda_largescaleknn_tpu.ops.candidates import (
+            tree_merge_candidates,
+        )
+        from mpi_cuda_largescaleknn_tpu.parallel.mesh import AXIS, get_mesh
+
+        r, q, k = 8, 16, 4
+        rng = np.random.default_rng(0)
+        vals = rng.choice(
+            np.float32([0.0, 0.25, 0.25, 0.5, 1.0, np.inf]),
+            size=(r * q, k))
+        d2 = np.sort(vals, axis=1)
+        idx = rng.integers(0, 99, size=(r * q, k)).astype(np.int32)
+        want_d, want_idx = _merge_shard_candidates(
+            d2.copy(), idx.copy(), r, q, k)
+
+        mesh = get_mesh(r)
+        spec = P(AXIS)
+
+        def body(d2_l, idx_l):
+            st = tree_merge_candidates(CandidateState(d2_l, idx_l), AXIS, r)
+            return st.dist2, st.idx
+
+        got_d2, got_idx = jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=(spec, spec), out_specs=(spec, spec)))(
+            jax.device_put(d2, NamedSharding(mesh, spec)),
+            jax.device_put(idx, NamedSharding(mesh, spec)))
+        # every device must hold the identical global top-k (all-reduce)
+        got_d2 = np.asarray(got_d2).reshape(r, q, k)
+        got_idx = np.asarray(got_idx).reshape(r, q, k)
+        for dev in range(r):
+            np.testing.assert_array_equal(np.sqrt(got_d2[dev][:, k - 1]),
+                                          want_d)
+            np.testing.assert_array_equal(got_idx[dev], want_idx)
+
+    def test_non_pow2_raises(self):
+        from mpi_cuda_largescaleknn_tpu.core.types import CandidateState
+        from mpi_cuda_largescaleknn_tpu.ops.candidates import (
+            tree_merge_candidates,
+        )
+
+        with pytest.raises(ValueError, match="power-of-two"):
+            tree_merge_candidates(CandidateState(None, None), "shards", 6)
+
+    @pytest.mark.parametrize("via", ["a2a", "tree"])
+    def test_device_merge_final_variants_equal_host(self, via):
+        """Both reductions behind device_merge_final — the all_to_all +
+        top_k reduce-scatter and the ppermute tree — must reproduce the
+        host merge bit-for-bit, ties included."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from mpi_cuda_largescaleknn_tpu.core.types import CandidateState
+        from mpi_cuda_largescaleknn_tpu.parallel.mesh import AXIS, get_mesh
+        from mpi_cuda_largescaleknn_tpu.parallel.ring import (
+            device_merge_final,
+        )
+
+        r, q, k = 4, 12, 5
+        rng = np.random.default_rng(7)
+        vals = rng.choice(
+            np.float32([0.0, 0.5, 0.5, 0.5, 2.0, np.inf]), size=(r * q, k))
+        d2 = np.sort(vals, axis=1)
+        idx = rng.integers(0, 77, size=(r * q, k)).astype(np.int32)
+        want_d, want_idx = _merge_shard_candidates(
+            d2.copy(), idx.copy(), r, q, k)
+
+        mesh = get_mesh(r)
+        spec = P(AXIS)
+
+        def body(d2_l, idx_l):
+            dd, _d2m, ii = device_merge_final(
+                CandidateState(d2_l, idx_l), r, via=via)
+            return dd, ii
+
+        got_d, got_idx = jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=(spec, spec), out_specs=(spec, spec)))(
+            jax.device_put(d2, NamedSharding(mesh, spec)),
+            jax.device_put(idx, NamedSharding(mesh, spec)))
+        np.testing.assert_array_equal(np.asarray(got_d), want_d)
+        np.testing.assert_array_equal(np.asarray(got_idx), want_idx)
+
+
+class TestHostMergeMicroFix:
+    """The argpartition rewrite of _merge_shard_candidates must be
+    output-identical to the stable full argsort it replaced."""
+
+    @staticmethod
+    def _reference(d2, idx, r, qpad, k):
+        d2 = d2.reshape(r, qpad, k).transpose(1, 0, 2).reshape(qpad, -1)
+        idx = idx.reshape(r, qpad, k).transpose(1, 0, 2).reshape(qpad, -1)
+        order = np.argsort(d2, axis=1, kind="stable")[:, :k]
+        return (np.sqrt(np.take_along_axis(d2, order, axis=1)[:, k - 1]),
+                np.take_along_axis(idx, order, axis=1))
+
+    def test_matches_stable_argsort_on_adversarial_ties(self):
+        rng = np.random.default_rng(1)
+        for _ in range(60):
+            r = int(rng.choice([1, 2, 3, 4, 8]))
+            k = int(rng.integers(1, 9))
+            qpad = int(rng.integers(1, 24))
+            vals = rng.choice(
+                np.float32([0.0, 0.125, 0.125, 0.125, 0.5, np.inf]),
+                size=(r * qpad, k))
+            d2 = np.sort(vals, axis=1)  # per-shard rows arrive sorted
+            idx = rng.integers(-1, 40, size=(r * qpad, k)).astype(np.int32)
+            got = _merge_shard_candidates(d2.copy(), idx.copy(), r, qpad, k)
+            want = self._reference(d2, idx, r, qpad, k)
+            np.testing.assert_array_equal(got[0], want[0])
+            np.testing.assert_array_equal(got[1], want[1])
+
+    def test_all_inf_rows(self):
+        r, qpad, k = 4, 3, 5
+        d2 = np.full((r * qpad, k), np.inf, np.float32)
+        idx = np.full((r * qpad, k), -1, np.int32)
+        d, nbrs = _merge_shard_candidates(d2, idx, r, qpad, k)
+        assert np.all(np.isinf(d))
+        assert np.all(nbrs == -1)
+
+
+class TestChunkedDeviceMerge:
+    """ring_knn_chunked(merge="device"): the replicate-traverse-merge chunk
+    path reuses the same reduction and must match the ring bit-for-bit."""
+
+    @staticmethod
+    def _sharded(points, r):
+        from mpi_cuda_largescaleknn_tpu.models.sharding import (
+            pad_and_flatten,
+            slab_bounds,
+        )
+
+        bounds = slab_bounds(len(points), r)
+        shards = [points[b:e] for b, e in bounds]
+        flat, ids, _c, _n = pad_and_flatten(
+            shards, id_bases=[b for b, _ in bounds])
+        return flat, ids
+
+    @pytest.mark.parametrize("engine", ["tiled", "bruteforce"])
+    def test_parity_with_ring_path(self, engine):
+        """Tie-free data: the two chunk strategies agree bit-for-bit on
+        everything — distances, candidate distances, AND neighbor ids."""
+        from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+        from mpi_cuda_largescaleknn_tpu.parallel.ring import ring_knn_chunked
+
+        points = random_points(512, seed=11)
+        mesh = get_mesh(8)
+        flat, ids = self._sharded(points, 8)
+        kw = dict(k=K, mesh=mesh, chunk_rows=16, engine=engine,
+                  bucket_size=32, return_candidates=True)
+        dh, ch = ring_knn_chunked(flat, ids, merge="host", **kw)
+        dd, cd = ring_knn_chunked(flat, ids, merge="device", **kw)
+        np.testing.assert_array_equal(dh, dd)
+        np.testing.assert_array_equal(np.asarray(ch.dist2),
+                                      np.asarray(cd.dist2))
+        np.testing.assert_array_equal(np.asarray(ch.idx),
+                                      np.asarray(cd.idx))
+
+    def test_duplicate_points_distances_exact_ids_true(self):
+        """Duplicate-heavy data: candidate DISTANCES still match the ring
+        bit-for-bit, but equal-distance id ORDER legitimately differs —
+        the ring resolves ties in fold-arrival order (own shard first,
+        per-device), the device merge in ascending (shard, slot) order,
+        the serving engine's discipline. Both id sets must be true
+        k-nearest by distance."""
+        from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+        from mpi_cuda_largescaleknn_tpu.parallel.ring import ring_knn_chunked
+        from tests.oracle import pairwise_dist2_np
+
+        points = _dup_points(512, seed=11)
+        mesh = get_mesh(8)
+        flat, ids = self._sharded(points, 8)
+        kw = dict(k=K, mesh=mesh, chunk_rows=16, engine="tiled",
+                  bucket_size=32, return_candidates=True)
+        dh, ch = ring_knn_chunked(flat, ids, merge="host", **kw)
+        dd, cd = ring_knn_chunked(flat, ids, merge="device", **kw)
+        np.testing.assert_array_equal(dh, dd)
+        np.testing.assert_array_equal(np.asarray(ch.dist2),
+                                      np.asarray(cd.dist2))
+        full = pairwise_dist2_np(points, points)
+        nbrs = np.asarray(cd.idx)[:len(points)]
+        got_d2 = np.sort(full[np.arange(len(points))[:, None], nbrs], axis=1)
+        want_d2 = np.sort(full, axis=1)[:, :K]
+        np.testing.assert_allclose(got_d2, want_d2, rtol=5e-7)
+
+    def test_checkpoint_resume_under_device_merge(self, tmp_path):
+        from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+        from mpi_cuda_largescaleknn_tpu.parallel.ring import ring_knn_chunked
+
+        points = random_points(512, seed=13)
+        mesh = get_mesh(8)
+        flat, ids = self._sharded(points, 8)
+        kw = dict(k=K, mesh=mesh, chunk_rows=16, engine="tiled",
+                  bucket_size=32, merge="device",
+                  checkpoint_dir=str(tmp_path))
+        ring_knn_chunked(flat, ids, max_chunks=2, **kw)
+        got = ring_knn_chunked(flat, ids, **kw)
+        want = ring_knn_chunked(flat, ids, k=K, mesh=mesh, chunk_rows=16,
+                                engine="tiled", bucket_size=32)
+        np.testing.assert_array_equal(got, want)
+
+    def test_multi_host_rejected(self, monkeypatch):
+        import jax
+
+        from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+        from mpi_cuda_largescaleknn_tpu.parallel.ring import ring_knn_chunked
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        with pytest.raises(ValueError, match="single-host"):
+            ring_knn_chunked(np.zeros((64, 3), np.float32),
+                             np.zeros(64, np.int32), K, get_mesh(8),
+                             chunk_rows=8, merge="device")
+
+
+class TestServeE2EDeviceMerge:
+    """The ISSUE's serving bar: oracle-exact answers through the full HTTP
+    stack at merge="device" with pipeline depth 2, recompile-free."""
+
+    @pytest.fixture(scope="class")
+    def dev_server(self):
+        from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+        from mpi_cuda_largescaleknn_tpu.serve.server import build_server
+
+        points = random_points(1200, seed=21)
+        eng = ResidentKnnEngine(points, K, mesh=get_mesh(8), engine="tiled",
+                                bucket_size=32, max_batch=128, min_batch=16,
+                                merge="device")
+        eng.warmup()
+        srv = build_server(eng, port=0, max_delay_s=0.002, pipeline_depth=2)
+        srv.ready = True
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        yield srv, points
+        srv.close()
+
+    @staticmethod
+    def _url(srv):
+        return f"http://127.0.0.1:{srv.server_address[1]}"
+
+    def test_concurrent_clients_oracle_exact(self, dev_server):
+        srv, points = dev_server
+        base = self._url(srv)
+        results = {}
+
+        def client(i):
+            q = random_points(5 + 3 * i, seed=200 + i)
+            req = urllib.request.Request(
+                base + "/knn",
+                data=json.dumps({"queries": q.tolist(),
+                                 "neighbors": True}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                results[i] = (q, resp.status, json.loads(resp.read()))
+
+        ths = [threading.Thread(target=client, args=(i,)) for i in range(6)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        assert len(results) == 6
+        for q, status, resp in results.values():
+            assert status == 200
+            assert_dist_equal(np.asarray(resp["dists"], np.float32),
+                              kth_nn_dist(q, points, K))
+            assert len(resp["neighbors"]) == len(q)
+
+    def test_compile_count_parity_and_stats(self, dev_server):
+        """All the pipelined device-merge traffic above stayed inside the
+        warmed AOT buckets; /stats and /metrics expose the merge mode and
+        the fetch accounting."""
+        srv, _ = dev_server
+        base = self._url(srv)
+        stats = json.loads(urllib.request.urlopen(
+            base + "/stats", timeout=10).read())
+        e = stats["engine"]
+        assert e["merge"] == "device"
+        assert e["compile_count"] == len(e["shape_buckets"])
+        assert e["fetch_bytes"] > 0 and e["result_rows"] > 0
+        m = urllib.request.urlopen(base + "/metrics",
+                                   timeout=10).read().decode()
+        assert "# TYPE knn_fetch_bytes_total counter" in m
+        assert "knn_result_rows_total" in m
+        assert 'knn_merge_mode{mode="device"} 1' in m

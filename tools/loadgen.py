@@ -120,6 +120,11 @@ def _server_pipeline_stats(url: str, timeout_s: float) -> dict | None:
         "mean_batch_rows": b.get("mean_batch_rows"),
         "engine": stats.get("engine", {}).get("engine"),
         "compile_count": stats.get("engine", {}).get("compile_count"),
+        # merge placement + cumulative fetch accounting: serve_smoke's
+        # host-vs-device comparison derives bytes-per-row from these
+        "merge": stats.get("engine", {}).get("merge"),
+        "fetch_bytes": stats.get("engine", {}).get("fetch_bytes"),
+        "result_rows": stats.get("engine", {}).get("result_rows"),
     }
 
 

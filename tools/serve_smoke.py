@@ -1,5 +1,6 @@
 #!/usr/bin/env python3
-"""Serving bench smoke: loadgen q/s + p50/p95/p99 at pipeline depth 1 vs 2.
+"""Serving bench smoke: loadgen q/s + p50/p95/p99 at pipeline depth 1 vs 2,
+plus merge=host vs merge=device at depth 2.
 
 Boots the full serving stack in-process on a CPU fixture (default: one
 virtual device, single-threaded Eigen, tiled engine — one core per
@@ -11,8 +12,17 @@ bench trajectory" item). One resident engine backs every depth — the shape
 buckets compile once, so the depths differ only in the batcher's
 dispatch/complete overlap, which is the thing being measured.
 
-Each depth's run also posts a fixed probe batch and checks it against the
-brute-force numpy oracle, so the report can assert "pipelined results are
+The merge comparison runs in a SUBPROCESS (--merge-bench) because it needs
+a multi-device mesh — the R-way cross-shard merge does not exist at R=1 —
+and the virtual device count is fixed per process at first jax import. It
+boots one engine per merge placement on the same points and reports q/s,
+p99, and the engine's cumulative fetch-bytes accounting: the device merge
+must fetch >= R x fewer result bytes per row (deterministic — it fetches
+one final [Q, k] instead of R partial [Q, k] pairs) at q/s no worse than
+parity (noisy on shared boxes; trajectory data, not a gate).
+
+Each run also posts a fixed probe batch and checks it against the
+brute-force numpy oracle, so the report can assert "results are
 oracle-exact" next to the throughput numbers it claims for them.
 
     python tools/serve_smoke.py --duration 3 --out BENCH_serve.json
@@ -163,7 +173,7 @@ def run_smoke(*, n_points=8192, k=16, depths=(1, 2), duration_s=3.0,
     out = {
         "kind": "serve_smoke",
         "n_points": n_points, "k": k, "devices": devices,
-        "engine": engine.engine_name,
+        "engine": engine.engine_name, "merge": engine.merge_mode,
         "compile_count": engine.compile_count, "warmup_s": round(warmup_s, 3),
         "duration_s": duration_s, "concurrency": concurrency, "batch": batch,
         "trials": trials, "per_depth": per_depth,
@@ -174,6 +184,86 @@ def run_smoke(*, n_points=8192, k=16, depths=(1, 2), duration_s=3.0,
         if d1["p99_ms"] and d2["p99_ms"]:
             out["p99_ratio_depth2_vs_1"] = round(
                 d2["p99_ms"] / d1["p99_ms"], 3)
+    return out
+
+
+def run_merge_bench(*, n_points=8192, k=16, devices=4, duration_s=2.0,
+                    concurrency=8, batch=64, max_batch=128,
+                    max_delay_s=0.008, trials=2, seed=0) -> dict:
+    """merge=host vs merge=device on an R-device mesh at pipeline depth 2.
+
+    One engine per placement (the AOT buckets are distinct programs), same
+    points, interleaved loadgen trials, median q/s. ``fetch_bytes_per_row``
+    comes from the engine's own counters — the headline
+    ``fetch_ratio_host_vs_device`` is deterministic arithmetic, not a
+    timing, and must be >= devices (the R x claim of the device merge).
+    """
+    _setup_cpu_fixture(devices)
+    from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+    from mpi_cuda_largescaleknn_tpu.serve.engine import ResidentKnnEngine
+    from mpi_cuda_largescaleknn_tpu.serve.server import build_server
+
+    rng = np.random.default_rng(seed)
+    points = rng.random((n_points, 3)).astype(np.float32)
+    mesh = get_mesh(devices)
+    engines = {}
+    for mode in ("host", "device"):
+        engines[mode] = ResidentKnnEngine(
+            points, k, mesh=mesh, engine="tiled", bucket_size=64,
+            max_batch=max_batch, min_batch=16, merge=mode)
+        engines[mode].warmup()
+
+    def one_trial(mode, trial):
+        eng = engines[mode]
+        srv = build_server(eng, port=0, max_delay_s=max_delay_s,
+                           pipeline_depth=2)
+        srv.ready = True
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        try:
+            exact = _probe_oracle_exact(base, points, k, seed)
+            rep = _run_loadgen(base, duration_s=duration_s,
+                               concurrency=concurrency, batch=batch,
+                               seed=seed + trial)
+            rep["oracle_exact"] = exact
+            return rep
+        finally:
+            srv.close()
+
+    one_trial("host", trials)  # cold-start burn (see run_smoke)
+    runs = {m: [] for m in ("host", "device")}
+    for trial in range(trials):
+        for mode in ("host", "device"):
+            runs[mode].append(one_trial(mode, trial))
+
+    per_merge = {}
+    for mode, reps in runs.items():
+        med = sorted(reps, key=lambda r: r["qps"])[len(reps) // 2]
+        st = engines[mode].stats()
+        rows = max(1, st["result_rows"])
+        per_merge[mode] = {
+            "qps": med["qps"], "p99_ms": med["p99_ms"],
+            "qps_trials": [r["qps"] for r in reps],
+            "oracle_exact": all(r["oracle_exact"] for r in reps),
+            "fetch_bytes_total": st["fetch_bytes"],
+            "result_rows": st["result_rows"],
+            "fetch_bytes_per_row": round(st["fetch_bytes"] / rows, 2),
+            "compile_count": st["compile_count"],
+        }
+
+    out = {
+        "kind": "serve_merge_bench", "devices": devices,
+        "n_points": n_points, "k": k, "pipeline_depth": 2,
+        "duration_s": duration_s, "concurrency": concurrency,
+        "batch": batch, "trials": trials, "per_merge": per_merge,
+    }
+    h, d = per_merge["host"], per_merge["device"]
+    if d["fetch_bytes_per_row"]:
+        out["fetch_ratio_host_vs_device"] = round(
+            h["fetch_bytes_per_row"] / d["fetch_bytes_per_row"], 2)
+    if h["qps"]:
+        out["qps_ratio_device_vs_host"] = round(d["qps"] / h["qps"], 3)
     return out
 
 
@@ -195,19 +285,75 @@ def main(argv=None) -> int:
                     help="batcher flush deadline (docs/TUNING.md)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", default=None, help="write JSON report here")
+    ap.add_argument("--merge-devices", type=int, default=4,
+                    help="mesh size for the merge=host-vs-device bench "
+                         "(0 skips it)")
+    ap.add_argument("--merge-bench", action="store_true",
+                    help="internal: run ONLY the merge bench in this "
+                         "process (needs its own virtual device count) "
+                         "and print its JSON")
     a = ap.parse_args(argv)
+
+    if a.merge_bench:
+        report = run_merge_bench(
+            n_points=a.points, k=a.k, devices=a.merge_devices,
+            duration_s=a.duration, concurrency=a.concurrency,
+            batch=a.batch, trials=max(1, a.trials - 1),
+            max_delay_s=a.max_delay_ms / 1e3, seed=a.seed)
+        print(json.dumps(report, indent=2))
+        ok = all(r["oracle_exact"] for r in report["per_merge"].values())
+        return 0 if ok else 1
 
     report = run_smoke(n_points=a.points, k=a.k,
                        depths=tuple(int(d) for d in a.depths.split(",")),
                        duration_s=a.duration, concurrency=a.concurrency,
                        batch=a.batch, trials=a.trials, devices=a.devices,
                        max_delay_s=a.max_delay_ms / 1e3, seed=a.seed)
+    ok = all(r.get("oracle_exact") for r in report["per_depth"].values())
+    if a.merge_devices > 0:
+        # subprocess: the merge bench needs an R-device mesh and the
+        # virtual device count is frozen at this process's first jax
+        # import — strip this process's fixture flags so the child's
+        # _setup_cpu_fixture can pin its own count
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = " ".join(
+            f for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+            and "xla_cpu_multi_thread_eigen" not in f).strip()
+        try:
+            child = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--merge-bench",
+                 "--points", str(a.points), "--k", str(a.k),
+                 "--duration", str(a.duration),
+                 "--concurrency", str(a.concurrency),
+                 "--batch", str(a.batch), "--trials", str(a.trials),
+                 "--merge-devices", str(a.merge_devices),
+                 "--max-delay-ms", str(a.max_delay_ms),
+                 "--seed", str(a.seed)],
+                capture_output=True, text=True, env=env,
+                timeout=120 + a.duration * (a.trials + 2) * 3)
+            mc = json.loads(child.stdout)
+            report["merge_compare"] = mc
+            # the exit contract gates on oracle-exactness ONLY: a measured
+            # exactness failure fails the run, bench-infrastructure
+            # hiccups below never do
+            ok = ok and all(v.get("oracle_exact")
+                            for v in mc.get("per_merge", {}).values())
+        except (subprocess.TimeoutExpired, json.JSONDecodeError) as e:
+            # degrade, never discard the depth results already measured —
+            # and never flip the exit code for a bench that could not run
+            if isinstance(e, json.JSONDecodeError):
+                detail = (child.stderr or child.stdout or "")[-1500:]
+            else:  # timeout: child never bound; the exception holds output
+                raw = e.stderr or e.stdout or b""
+                detail = (raw.decode(errors="replace")
+                          if isinstance(raw, bytes) else str(raw))[-1500:]
+            report["merge_compare"] = {"error": f"{str(e)[:300]} :: {detail}"}
     text = json.dumps(report, indent=2)
     print(text)
     if a.out:
         with open(a.out, "w") as f:
             f.write(text + "\n")
-    ok = all(r.get("oracle_exact") for r in report["per_depth"].values())
     return 0 if ok else 1
 
 
