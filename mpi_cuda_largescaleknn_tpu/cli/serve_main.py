@@ -38,6 +38,12 @@ SERVE_FLAGS = """
                     R partials; auto = device on power-of-two meshes
   --shards N        size of the 1-D device mesh (default: all devices)
   --bucket-size N   points per spatial bucket (0 = engine-tuned auto)
+  --query-buckets N query-side buckets per padded batch (0 = auto, ~k
+                    queries per bucket; 1 = single whole-batch bucket AND
+                    disables the Morton admission sort — the pre-locality
+                    behavior). Served batches are Morton-sorted so the
+                    buckets are spatially tight, tightening each bucket's
+                    prune radius; see docs/TUNING.md "Query locality"
   --max-batch N     widest padded query batch / shape bucket (default 1024)
   --min-batch N     narrowest shape bucket (default 8)
   --max-delay-ms F  micro-batch flush deadline (default 2.0)
@@ -63,7 +69,8 @@ def parse_serve_args(argv: list[str]) -> dict:
     opt = {"k": 0, "max_radius": math.inf, "in_path": "", "port": 8080,
            "host": "127.0.0.1", "engine": "auto", "merge": "auto",
            "shards": None,
-           "bucket_size": 0, "max_batch": 1024, "min_batch": 8,
+           "bucket_size": 0, "query_buckets": 0,
+           "max_batch": 1024, "min_batch": 8,
            "max_delay_ms": 2.0, "pipeline_depth": 2,
            "max_queue_rows": 4096,
            "timeout_ms": 5000.0, "warmup": True, "timings": False,
@@ -90,6 +97,8 @@ def parse_serve_args(argv: list[str]) -> dict:
                 i += 1; opt["shards"] = int(argv[i])
             elif arg == "--bucket-size":
                 i += 1; opt["bucket_size"] = int(argv[i])
+            elif arg == "--query-buckets":
+                i += 1; opt["query_buckets"] = int(argv[i])
             elif arg == "--max-batch":
                 i += 1; opt["max_batch"] = int(argv[i])
             elif arg == "--min-batch":
@@ -138,7 +147,8 @@ def main(argv: list[str] | None = None) -> int:
         points, opt["k"], mesh=get_mesh(opt["shards"]),
         engine=opt["engine"], bucket_size=opt["bucket_size"],
         max_radius=opt["max_radius"], max_batch=opt["max_batch"],
-        min_batch=opt["min_batch"], merge=opt["merge"])
+        min_batch=opt["min_batch"], merge=opt["merge"],
+        query_buckets=opt["query_buckets"])
     server = build_server(
         engine, host=opt["host"], port=opt["port"],
         max_delay_s=opt["max_delay_ms"] / 1e3,

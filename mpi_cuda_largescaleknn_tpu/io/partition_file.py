@@ -14,22 +14,23 @@ from __future__ import annotations
 
 import numpy as np
 
-
-def _expand_bits21(v: np.ndarray) -> np.ndarray:
-    v = v & np.uint64(0x1FFFFF)
-    v = (v | v << np.uint64(32)) & np.uint64(0x1F00000000FFFF)
-    v = (v | v << np.uint64(16)) & np.uint64(0x1F0000FF0000FF)
-    v = (v | v << np.uint64(8)) & np.uint64(0x100F00F00F00F00F)
-    v = (v | v << np.uint64(4)) & np.uint64(0x10C30C30C30C30C3)
-    v = (v | v << np.uint64(2)) & np.uint64(0x1249249249249249)
-    return v
+# one bit-dilation core for the whole repo (verified bit-identical to the
+# C++ expandBits over the full 21-bit domain)
+from mpi_cuda_largescaleknn_tpu.utils.math import _part1by2 as _expand_bits21
 
 
 def morton_codes(pts: np.ndarray, lo: np.ndarray, inv_ext: np.ndarray,
                  bits: int) -> np.ndarray:
     """Quantized 3-D Morton codes — bit-identical to the C++ ``morton3``:
     float32 ``(p - lo) * inv_ext``, float64 scaling by ``2^bits - 1``,
-    truncation, clamp."""
+    truncation, clamp.
+
+    NOT interchangeable with ``utils/math.py morton_codes`` (the serving
+    admission sort): that one puts x in the LOW interleave position,
+    quantizes in float64 with below-box clamping, and maps sentinel rows
+    to a pads-last max code; this one reproduces the C++ partitioner bit
+    for bit (x HIGH, float32 arithmetic, truncate-and-clamp-above). Both
+    share the ``_part1by2`` dilation core."""
     max_q = np.uint64((1 << bits) - 1)
     t = (pts.astype(np.float32) - lo.astype(np.float32)) \
         * inv_ext.astype(np.float32)                    # float32, like C++

@@ -163,6 +163,14 @@ class PhaseTimers:
         with self._counter_lock:
             return self.counters.get(name, 0)
 
+    def counters_snapshot(self) -> dict:
+        """Atomic copy of every counter — for before/after deltas across a
+        measurement window (e.g. serve_smoke's locality bench diffing
+        ``tiles_executed`` over one loadgen run) without racing concurrent
+        completion-thread increments between two ``counter()`` reads."""
+        with self._counter_lock:
+            return dict(self.counters)
+
     def report(self) -> dict:
         # list() snapshots: a serving /stats scrape may race a worker thread
         # inserting a new phase or histogram mid-iteration

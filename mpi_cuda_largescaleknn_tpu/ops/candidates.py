@@ -45,23 +45,71 @@ def init_candidates(num_queries: int, k: int, max_radius: float = jnp.inf) -> Ca
 
 
 def merge_candidates(state: CandidateState, cand_dist2: jnp.ndarray,
-                     cand_idx: jnp.ndarray) -> CandidateState:
+                     cand_idx: jnp.ndarray,
+                     canonical: bool = False) -> CandidateState:
     """Merge a tile of candidates ``(f32[Q,T], i32[Q,T])`` into the state.
 
     Keeps the k smallest of the union per row. Stable ordering with existing
     entries first reproduces the heap's strict-< insertion: a candidate tied
-    with the current worst slot does not displace it.
-    """
+    with the current worst slot does not displace it — equal-distance
+    candidates therefore keep FOLD-ARRIVAL order, which depends on the
+    caller's visit schedule.
+
+    ``canonical=True`` switches the tie discipline to the total order
+    (dist2, idx): rows come out ascending by distance THEN id, and the kept
+    set at the k-boundary is the k smallest under that order — so the merged
+    result is independent of the order in which tiles were folded (any two
+    fold schedules over the same candidates produce bit-identical rows).
+    The serving engine's multi-bucket traversal requires this: different
+    query-bucket geometries visit point buckets in different orders, and the
+    canonical order is what makes them bitwise comparable
+    (tests/test_query_locality.py). Init slots still win their ties
+    (``idx == -1`` sorts before every real id at the cutoff distance), so
+    strict-< adoption against ``max_radius`` is preserved. The boundary
+    tie-fix runs the id selection through a f32 ``top_k`` (XLA:CPU lowers
+    integer TopK to a scalar loop ~7x slower), so ids must stay below 2**24
+    to round-trip exactly — callers gate on index size
+    (serve/engine.py)."""
     k = state.dist2.shape[1]
     t = cand_dist2.shape[1]
     if t > k:
         # pre-reduce the tile to its own k best to keep the sort width at 2k
         neg, pos = jax.lax.top_k(-cand_dist2, k)
-        cand_dist2 = -neg
-        cand_idx = jnp.take_along_axis(cand_idx, pos, axis=1)
+        v = -neg
+        ids = jnp.take_along_axis(cand_idx, pos, axis=1)
+        if canonical:
+            # top_k resolves ties by lane, which may DROP a tied candidate
+            # with a smaller id at the tile's k-boundary. The boundary class
+            # is the trailing block of v (ascending, kth = max); replace its
+            # ids with the smallest ids among ALL lanes tied at kth. Guarded
+            # by a cond: boundary ties are rare in real float data, so the
+            # common case pays one elementwise scan, not a second top_k.
+            # (d2 == inf ties need no fix: (inf, id>=0) never displaces the
+            # init slots' (inf, -1) under the 2-key sort below.)
+            kth = v[:, k - 1:k]
+            tied_lane = cand_dist2 == kth
+            tied_out = v == kth
+            tcount = jnp.sum(tied_out, axis=1)
+            needs = jnp.any((jnp.sum(tied_lane, axis=1) > tcount)
+                            & jnp.isfinite(kth[:, 0]))
+
+            def fix(ids):
+                tidf = jnp.where(tied_lane, cand_idx.astype(jnp.float32),
+                                 jnp.inf)
+                tneg, _ = jax.lax.top_k(-tidf, k)
+                tl = -tneg  # ascending tied ids (inf-padded)
+                j = jax.lax.broadcasted_iota(jnp.int32, v.shape, 1)
+                rank = jnp.clip(j - (k - tcount[:, None]), 0, k - 1)
+                picked = jnp.take_along_axis(tl, rank, axis=1)
+                return jnp.where(tied_out & jnp.isfinite(kth),
+                                 picked.astype(jnp.int32), ids)
+
+            ids = jax.lax.cond(needs, fix, lambda i: i, ids)
+        cand_dist2, cand_idx = v, ids
     cat_d2 = jnp.concatenate([state.dist2, cand_dist2], axis=1)
     cat_idx = jnp.concatenate([state.idx, cand_idx], axis=1)
-    sorted_d2, sorted_idx = jax.lax.sort((cat_d2, cat_idx), num_keys=1,
+    sorted_d2, sorted_idx = jax.lax.sort((cat_d2, cat_idx),
+                                         num_keys=2 if canonical else 1,
                                          dimension=1, is_stable=True)
     return CandidateState(sorted_d2[:, :k], sorted_idx[:, :k])
 

@@ -289,7 +289,8 @@ def knn_update_tiled_pallas(state: CandidateState, q: BucketedPoints,
                             interpret: bool | None = None,
                             with_stats: bool | str = False,
                             visit_batch: int | None = None,
-                            skip_self=None, self_group: int = 1):
+                            skip_self=None, self_group: int = 1,
+                            canonical_ties: bool = False):
     """Drop-in Pallas twin of ``ops.tiled.knn_update_tiled`` (same contract:
     state rows in ``q``'s bucket order; folds every real point of ``p`` in;
     ``with_stats`` additionally returns the i32 count of [S, T] tiles
@@ -304,6 +305,17 @@ def knn_update_tiled_pallas(state: CandidateState, q: BucketedPoints,
     ``skip_self``/``self_group`` as in the twin: nonzero masks point bucket
     b // self_group out of query bucket b's traversal for warm-started
     self-joins).
+
+    ``canonical_ties``: re-sort the finished candidate rows by the
+    (dist2, idx) total order — the serving engine's multi-bucket tie
+    discipline (ops/tiled.py). NOTE the twin difference: the XLA twin's
+    canonical mode also makes the kept SET at the k-boundary canonical (its
+    fold adopts ties by id and its visit predicate is non-strict); this
+    kernel's in-VMEM fold keeps strict-< adoption, so at an exact
+    equal-distance k-boundary straddling point buckets the kept ids can
+    still follow visit order. Distances are exact either way; only
+    duplicate-point id choices at that razor's edge differ (docs/TUNING.md
+    "Query locality").
 
     Precondition: ``p.ids`` and ``state.idx`` entries must be ``>= -1``
     (true of everything this package produces — real ids are ``>= 0``, the
@@ -373,6 +385,11 @@ def knn_update_tiled_pallas(state: CandidateState, q: BucketedPoints,
     bucket = jnp.take_along_axis(order, pos // t_pad, axis=1)
     ids_new = jnp.take(pid.reshape(-1), bucket * t_pad + pos % t_pad, axis=0)
     out_idx = jnp.where(enc <= -2, ids_new, enc).reshape(out_idx.shape)
+    if canonical_ties:
+        # one [rows, k] two-key sort per call (not per visit): rows come
+        # back ascending (dist2, idx) like the XLA twin's canonical mode
+        out_d2, out_idx = lax.sort((out_d2, out_idx), num_keys=2,
+                                   dimension=1, is_stable=True)
     out = CandidateState(out_d2, out_idx)
     if with_stats == "full":
         return (out, jnp.sum(visits[:, :, 0]).astype(jnp.int32),

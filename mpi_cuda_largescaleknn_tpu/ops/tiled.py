@@ -119,10 +119,23 @@ def warm_start_self(q: BucketedPoints, k: int,
                           hidx.reshape(num_qb * s, k))
 
 
+def tile_schedule_slots(num_pb: int, visits_per_step: int = 8) -> int:
+    """Visit slots in ONE query bucket's schedule, pad visits included —
+    the per-query-bucket ceiling for tile-skip accounting. ``knn_update_tiled``
+    counts ``chunk * V`` tiles for every step with >= 1 active bucket (the
+    dense tile really covers the masked lanes), so a traversal of ``Bq``
+    query buckets executes at most ``Bq * tile_schedule_slots(Bp)`` tiles;
+    the shortfall is what pruning skipped (serve/engine.py's
+    ``tiles_skipped`` counter)."""
+    v = max(1, min(visits_per_step, num_pb))
+    return -(-num_pb // v) * v
+
+
 def knn_update_tiled(state: CandidateState, q: BucketedPoints,
                      p: BucketedPoints, *, chunk_buckets: int | None = None,
                      visits_per_step: int = 8, with_stats: bool | str = False,
-                     skip_self=None, self_group: int = 1):
+                     skip_self=None, self_group: int = 1,
+                     canonical_ties: bool = False):
     """Fold every real point of ``p`` into the candidate state (one
     reference ``runQuery`` launch, at bucket granularity).
 
@@ -146,6 +159,19 @@ def knn_update_tiled(state: CandidateState, q: BucketedPoints,
     self-joins whose heap was pre-filled by ``warm_start_self`` (``p``
     must then be ``coarsen_buckets`` of ``q``'s partition with the same
     ``self_group``, so bucket indices correspond).
+
+    ``canonical_ties``: use the (dist2, idx) total order for equal-distance
+    candidates (``merge_candidates(canonical=True)``) AND visit buckets
+    whose box distance EQUALS the prune radius (``<=`` instead of ``<``).
+    Together these make the result independent of the visit schedule — two
+    different query bucketings of the same rows produce bit-identical
+    candidate rows, which is the serving engine's multi-bucket exactness
+    contract. The non-strict visit predicate is required for set-exactness:
+    a bucket at box distance exactly equal to a row's k-th candidate
+    distance can hold a TIED candidate with a smaller id that the canonical
+    order must admit. (With the default fold-arrival discipline the same
+    bucket is safely skippable — a tie never displaces — which is why the
+    default keeps ``<``: identical results, strictly fewer visits.)
     """
     num_qb, s_q = q.ids.shape
     num_pb, s_p = p.ids.shape
@@ -158,13 +184,18 @@ def knn_update_tiled(state: CandidateState, q: BucketedPoints,
 
     sorted_d2, order = nearest_first_order(q.lower, q.upper,
                                            p.lower, p.upper)  # [Bq, Bp] x2
-    # pad the schedule to a multiple of V: padded visits carry +inf box
-    # distance (never active) and a valid dummy index
+    # pad the schedule to a multiple of V: padded visits carry a
+    # never-active box distance and a valid dummy index (bucket 0!) —
+    # +inf normally, but NaN under canonical ties, whose <= predicate
+    # would otherwise go live at +inf while a row's radius is still inf
+    # and fold the dummy bucket a second time (NaN compares false under
+    # both predicates; the early-exit cond only ever reads real slots)
     n_steps = -(-num_pb // v)
     pad_v = n_steps * v - num_pb
     if pad_v:
+        pad_fill = jnp.nan if canonical_ties else jnp.inf
         sorted_d2 = jnp.concatenate(
-            [sorted_d2, jnp.full((num_qb, pad_v), jnp.inf, sorted_d2.dtype)],
+            [sorted_d2, jnp.full((num_qb, pad_v), pad_fill, sorted_d2.dtype)],
             axis=1)
         order = jnp.concatenate(
             [order, jnp.zeros((num_qb, pad_v), order.dtype)], axis=1)
@@ -175,17 +206,24 @@ def knn_update_tiled(state: CandidateState, q: BucketedPoints,
 
     q_chunked = q.pts.reshape(n_chunks, chunk, s_q, 3)
 
+    def live(box_d2, radius2):
+        # canonical mode must VISIT buckets tied exactly at the prune radius
+        # (they can hold equal-distance candidates the (d2, id) order
+        # admits); the default's strict < skips them — a tie never
+        # displaces under fold-arrival order, so skipping is free there
+        return box_d2 <= radius2 if canonical_ties else box_d2 < radius2
+
     def cond(carry):
         _hd2, _hidx, worst2, step, _tiles = carry
         next_d2 = lax.dynamic_index_in_dim(sorted_d2, jnp.minimum(
             step * v, num_pb - 1), axis=1, keepdims=False)
-        return (step < n_steps) & jnp.any(next_d2 < worst2)
+        return (step < n_steps) & jnp.any(live(next_d2, worst2))
 
     def body(carry):
         hd2, hidx, worst2, step, tiles = carry
         visit = lax.dynamic_slice_in_dim(order, step * v, v, axis=1)
         visit_d2 = lax.dynamic_slice_in_dim(sorted_d2, step * v, v, axis=1)
-        active = visit_d2 < worst2[:, None]                      # [Bq, V]
+        active = live(visit_d2, worst2[:, None])                 # [Bq, V]
         if skip_self is not None:
             own = (jnp.arange(num_qb, dtype=visit.dtype)
                    // self_group)[:, None]
@@ -213,7 +251,8 @@ def knn_update_tiled(state: CandidateState, q: BucketedPoints,
                     jnp.broadcast_to(
                         pid.reshape(chunk, 1, v * s_p),
                         (chunk, s_q, v * s_p)).reshape(
-                            chunk * s_q, v * s_p))
+                            chunk * s_q, v * s_p),
+                    canonical=canonical_ties)
                 return (st.dist2.reshape(chunk, s_q, k),
                         st.idx.reshape(chunk, s_q, k))
 

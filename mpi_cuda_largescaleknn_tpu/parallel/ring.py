@@ -68,6 +68,7 @@ from mpi_cuda_largescaleknn_tpu.ops.tiled import (
 )
 from mpi_cuda_largescaleknn_tpu.ops.traverse import knn_update_tree
 from mpi_cuda_largescaleknn_tpu.parallel.mesh import AXIS, pvary
+from mpi_cuda_largescaleknn_tpu.utils.math import next_pow2
 
 
 @lru_cache(maxsize=32)  # bounded: chunked drivers with varying chunk shapes
@@ -186,6 +187,32 @@ def resolve_merge(merge: str, num_shards: int) -> str:
         return "host"
     raise ValueError(f"unknown merge mode '{merge}' "
                      "(expected host | device | auto)")
+
+
+def resolve_query_buckets(query_buckets: int, qpad: int, k: int) -> int:
+    """Resolve the serving engine's query-bucket count for one padded batch
+    shape (0 = auto). Like ``resolve_bucket_size``, the auto value encodes
+    the measured tradeoff core/config.py names: FINE query buckets tighten
+    the per-bucket prune radius (each bucket's radius is the max over only
+    ITS queries — ops/tiled.py ``_worst2``) and give ``nearest_first_order``
+    a tight AABB to schedule against, while buckets below ~k rows shrink
+    the [S, k] candidate tile past what the sublane padding and the
+    per-bucket schedule overhead repay. Auto therefore targets
+    ``next_pow2(max(8, k))`` queries per bucket.
+
+    The result always divides ``qpad`` (both are powers of two) and leaves
+    at least 8 rows per bucket; explicit values are rounded up to a power
+    of two and clamped into that range. 1 = the single whole-batch bucket
+    (the pre-locality serving behavior, and the B=1 baseline of
+    ``tools/serve_smoke.py --locality-bench``)."""
+    if qpad < 16:
+        return 1
+    cap = qpad // 8
+    if query_buckets < 1:  # auto
+        b = qpad // next_pow2(max(8, k))
+    else:
+        b = next_pow2(query_buckets)
+    return max(1, min(b, cap))
 
 
 def device_merge_final(heap: CandidateState, num_shards: int,

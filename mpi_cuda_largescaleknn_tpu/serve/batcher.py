@@ -8,7 +8,12 @@ queued rows reach ``max_batch`` OR the oldest request has waited
 ``max_delay_s`` — the classic throughput/latency dial. A flush concatenates
 whole requests (never splitting one across engine calls keeps demux
 trivial), pads to the smallest covering shape bucket inside the engine, and
-demuxes per-request slices back to each caller.
+demuxes per-request slices back to each caller. The engine may Morton-sort
+the flushed batch internally for query locality (serve/engine.py), but it
+un-permutes at ``complete`` — so the offset demux here stays position-based
+and order-oblivious, and coalescing MORE concurrent requests per flush
+actively helps: the sort regroups rows from different callers into
+spatially tight query buckets the traversal prunes harder.
 
 Pipelining (``pipeline_depth > 1``): when ``query_fn`` exposes the engine's
 ``dispatch``/``complete`` split, flushes run on a DISPATCH worker that

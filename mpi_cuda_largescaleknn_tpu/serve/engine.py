@@ -36,6 +36,24 @@ blocking ``complete`` (resolve the launch future, fetch, R-way merge,
 slice) so the batcher can keep batch t+1's device traversal in flight while
 batch t's host merge runs — the serving-side analogue of the ring's
 communication/compute overlap.
+
+Query locality: the whole speedup of the tiled traversal is the per-bucket
+prune radius (ops/tiled.py ``_worst2``) — and a served batch of scattered
+user queries wrapped in ONE bucket widens that radius to the max over the
+batch, degrading toward brute force. So ``dispatch`` first sorts each batch
+by 3-D Morton code over the index bounding box (utils/math.py; pads sort
+last) and the query program traverses ``query_buckets`` contiguous slices
+of the sorted batch, each with its own in-program AABB and radius — sorted
+order makes contiguous slices spatially tight, so the prune actually bites.
+``complete`` un-permutes the merged rows, so callers never observe the
+sort. The traversal runs with the canonical (dist2, id) tie order
+(ops/candidates.py ``merge_candidates(canonical=True)``), which makes the
+result bit-identical across bucket geometries — ``query_buckets=1``
+(unsorted, the old behavior) and any B produce the same bytes, ties
+included (tests/test_query_locality.py). The tile counters the traversal
+already carries are surfaced as ``tiles_executed`` / ``tiles_skipped``
+engine counters (and /metrics), so the locality win is a number:
+``tools/serve_smoke.py --locality-bench``.
 """
 
 from __future__ import annotations
@@ -65,22 +83,28 @@ class UnservableShapeError(ValueError):
 class _InFlightBatch:
     """A dispatched-but-uncompleted engine call (``dispatch`` -> ``complete``).
 
-    ``fut`` resolves to the executable's result pair on the engine's launch
-    thread — (d2, idx) per-shard partials under ``merge="host"``, the final
-    (dists, idx) under ``merge="device"``; ``merge_mode`` records which, so
-    ``complete`` demuxes the right way. ``queries`` retains the original
-    host batch so a completion-time failure (async Pallas errors surface at
+    ``fut`` resolves to the executable's result triple on the engine's
+    launch thread — (d2, idx, tiles) per-shard partials under
+    ``merge="host"``, the final (dists, idx, tiles) under
+    ``merge="device"``; ``merge_mode`` records which, so ``complete``
+    demuxes the right way. ``queries`` retains the ORIGINAL (unsorted) host
+    batch so a completion-time failure (async Pallas errors surface at
     fetch, not at launch) can be replayed on the degraded twin — which
     replays under the engine's CURRENT merge mode, the twin contract being
     merge-placement-independent. ``engine_name`` records which engine
     DISPATCHED it — after a mid-stream degradation, stale handles are
-    distinguishable from twin failures.
+    distinguishable from twin failures. ``perm`` is the Morton admission
+    sort (None when sorting is off): row i of the staged batch is
+    ``queries[perm[i]]``, so ``complete`` scatters results back through it.
+    ``tiles_possible`` is the program's static tile-schedule ceiling — the
+    skipped-tile counter's denominator.
     """
 
     __slots__ = ("queries", "n", "qpad", "engine_name", "merge_mode",
-                 "fut", "t0")
+                 "fut", "t0", "perm", "tiles_possible")
 
-    def __init__(self, queries, n, qpad, engine_name, merge_mode, fut, t0):
+    def __init__(self, queries, n, qpad, engine_name, merge_mode, fut, t0,
+                 perm=None, tiles_possible=0):
         self.queries = queries
         self.n = n
         self.qpad = qpad
@@ -88,6 +112,8 @@ class _InFlightBatch:
         self.merge_mode = merge_mode
         self.fut = fut
         self.t0 = t0
+        self.perm = perm
+        self.tiles_possible = tiles_possible
 
 
 class ResidentKnnEngine:
@@ -101,7 +127,8 @@ class ResidentKnnEngine:
     def __init__(self, points: np.ndarray, k: int, *, mesh=None,
                  engine: str = "auto", bucket_size: int = 0,
                  max_radius: float = math.inf, max_batch: int = 1024,
-                 min_batch: int = 8, merge: str = "auto"):
+                 min_batch: int = 8, merge: str = "auto",
+                 query_buckets: int = 0):
         import jax
 
         from mpi_cuda_largescaleknn_tpu.parallel.mesh import AXIS, get_mesh
@@ -109,6 +136,7 @@ class ResidentKnnEngine:
             resolve_bucket_size,
             resolve_engine,
             resolve_merge,
+            resolve_query_buckets,
         )
 
         points = np.asarray(points, np.float32)
@@ -148,6 +176,29 @@ class ResidentKnnEngine:
                               (min_batch << i for i in range(64))
                               if b <= max_batch] or [min_batch]
         self.max_batch = self.shape_buckets[-1]
+        #: query_buckets knob (0 = auto, 1 = single whole-batch bucket =
+        #: the pre-locality behavior). Resolved per padded shape: the map
+        #: below is part of each shape bucket's AOT program identity.
+        #: Flat engines have no buckets to traverse, so they stay at 1.
+        use_tiled = self.engine_name in ("tiled", "pallas_tiled")
+        self.query_buckets_setting = int(query_buckets)
+        self.query_buckets = {
+            q: (resolve_query_buckets(query_buckets, q, self.k)
+                if use_tiled else 1)
+            for q in self.shape_buckets}
+        #: Morton admission: sort every dispatched batch by Z-order code
+        #: over the index bbox (pads last), un-permuted at complete().
+        #: Off when the caller pinned query_buckets=1 — that configuration
+        #: IS the unsorted baseline the exactness tests and the locality
+        #: bench compare against.
+        self.sort_queries = use_tiled and self.query_buckets_setting != 1
+        #: canonical (dist2, id) tie order inside the traversal — what
+        #: makes results bit-identical across query bucket geometries. The
+        #: boundary tie-fix routes ids through a f32 top_k (exact below
+        #: 2**24; XLA:CPU's integer TopK is a scalar loop), so huge indices
+        #: fall back to fold-arrival ties (distances stay exact; only
+        #: equal-distance id CHOICES may then differ across geometries)
+        self.canonical_ties = use_tiled and self.n_points < (1 << 24)
         self.timers = PhaseTimers()
         self.compile_count = 0
         self.degraded_reason: str | None = None
@@ -179,6 +230,10 @@ class ResidentKnnEngine:
         from mpi_cuda_largescaleknn_tpu.parallel.mesh import AXIS
         from mpi_cuda_largescaleknn_tpu.parallel.ring import partition_sharded
 
+        # index bounding box: the Morton admission sort's quantization
+        # frame (queries outside it clamp to the faces — still ordered)
+        self._index_lo = points.min(axis=0) if len(points) else np.zeros(3)
+        self._index_hi = points.max(axis=0) if len(points) else np.ones(3)
         bounds = slab_bounds(len(points), self.num_shards)
         shards = [points[b:e] for b, e in bounds]
         flat, ids, _counts, self.npad_local = pad_and_flatten(
@@ -203,7 +258,7 @@ class ResidentKnnEngine:
         raise UnservableShapeError(
             f"batch of {n} queries exceeds max_batch {self.max_batch}")
 
-    def _build_query_fn(self, engine_name: str, qpad: int):
+    def _build_query_fn(self, engine_name: str, qpad: int, qbuckets: int):
         import jax
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
@@ -222,22 +277,25 @@ class ResidentKnnEngine:
         k, max_radius = self.k, self.max_radius
         num_shards = self.num_shards
         device_merge = self.merge_mode == "device"
+        canonical = self.canonical_ties
 
-        def finish(st):
+        def finish(st, tiles):
             # per-shard local top-k -> program output. Host merge: emit the
             # R partial candidate blocks (the host's stable sort finishes).
             # Device merge: reduce to the global top-k in-program and emit
             # this device's 1/R slice of the final (dists, idx) — the
-            # fetched global arrays are exactly [qpad] + [qpad, k]
+            # fetched global arrays are exactly [qpad] + [qpad, k]. The
+            # third output is this device's executed-tile count [1].
             if not device_merge:
-                return st.dist2, st.idx
+                return st.dist2, st.idx, tiles
             dists, _d2, idx = device_merge_final(st, num_shards)
-            return dists, idx
+            return dists, idx, tiles
 
         use_tiled = engine_name in ("tiled", "pallas_tiled")
 
         if use_tiled:
             tiled_update = _tiled_engine_fn(engine_name)
+            s_q = qpad // qbuckets
 
             def body(bpts, bids, blo, bhi, q):
                 # q f32[qpad,3] is REPLICATED: every device traverses its own
@@ -245,23 +303,46 @@ class ResidentKnnEngine:
                 # exact over that shard, and the merge of the R partial
                 # candidate rows — host-side or in-program — is exact over
                 # the union (the ring's merge-across-rounds argument, with
-                # space instead of time)
+                # space instead of time). The batch rides as ``qbuckets``
+                # CONTIGUOUS slices, each with its own tight AABB: dispatch
+                # Morton-sorted the rows, so slice = neighborhood, and the
+                # per-bucket prune radius is the max over ~qpad/B coherent
+                # queries instead of the whole batch. All-pad tail buckets
+                # get inverted (+inf/-inf) bounds — never visited, and
+                # their -inf radius never keeps the traversal alive.
                 valid = q[:, 0] < PAD_SENTINEL / 2
                 qids = jnp.where(valid, jnp.arange(qpad, dtype=jnp.int32), -1)
-                lo = jnp.min(jnp.where(valid[:, None], q, jnp.inf), axis=0)
-                hi = jnp.max(jnp.where(valid[:, None], q, -jnp.inf), axis=0)
-                qb = BucketedPoints(q[None], qids[None], lo[None], hi[None],
-                                    qids[None])
+                qg = q.reshape(qbuckets, s_q, 3)
+                vg = valid.reshape(qbuckets, s_q, 1)
+                lo = jnp.min(jnp.where(vg, qg, jnp.inf), axis=1)
+                hi = jnp.max(jnp.where(vg, qg, -jnp.inf), axis=1)
+                qb = BucketedPoints(qg, qids.reshape(qbuckets, s_q), lo, hi,
+                                    qids.reshape(qbuckets, s_q))
                 heap = pvary(init_candidates(qpad, k, max_radius))
                 resident = BucketedPoints(bpts, bids, blo, bhi, bids)
-                return finish(tiled_update(heap, qb, resident))
+                kw = dict(with_stats=True, canonical_ties=canonical)
+                if engine_name == "tiled":
+                    # chunk = ONE query bucket: the lax.map cond skips at
+                    # per-bucket granularity, so a finished bucket stops
+                    # paying for stragglers — measured faster at every B
+                    # on the serving shapes, and it is what makes the
+                    # tile-skip counters bucket-granular
+                    kw["chunk_buckets"] = 1
+                st, tiles = tiled_update(heap, qb, resident, **kw)
+                # counters ride in TILE-ROW units (one query row folded
+                # against one [T]-lane point tile): raw tile counts are
+                # [s_q, T]-shaped and s_q varies with B, so scaling by s_q
+                # makes executed/possible comparable across bucketings
+                return finish(st, jnp.reshape(tiles * s_q, (1,)))
 
             in_specs = (P(AXIS),) * 4 + (P(),)
         else:
 
             def body(spts, sids, q):
                 heap = pvary(init_candidates(qpad, k, max_radius))
-                return finish(knn_update_bruteforce(heap, q, spts, sids))
+                st = knn_update_bruteforce(heap, q, spts, sids)
+                # flat engines score every pair; no tiles to count
+                return finish(st, pvary(jnp.zeros((1,), jnp.int32)))
 
             in_specs = (P(AXIS),) * 2 + (P(),)
 
@@ -275,7 +356,7 @@ class ResidentKnnEngine:
                   if jax.default_backend() == "tpu" else ())
         return jax.jit(jax.shard_map(
             body, mesh=self.mesh, in_specs=in_specs,
-            out_specs=(P(AXIS), P(AXIS)), check_vma=check_vma),
+            out_specs=(P(AXIS), P(AXIS), P(AXIS)), check_vma=check_vma),
             donate_argnums=donate)
 
     def _resident_args(self, engine_name: str):
@@ -284,24 +365,46 @@ class ResidentKnnEngine:
             return (b.pts, b.ids, b.lower, b.upper)
         return (self._flat_pts, self._flat_ids)
 
+    def _tiles_possible(self, engine_name: str, qpad: int) -> int:
+        """Static ceiling of one batch's traversal in TILE-ROW units
+        (query row x [T]-lane point-tile visit), summed over shards — the
+        ``tiles_skipped`` counter's denominator. Row units make the
+        ceiling independent of the query bucketing (B buckets x qpad/B
+        rows x slots == qpad x slots), so executed/skipped are directly
+        comparable across ``query_buckets`` settings. The XLA twin counts
+        every schedule slot of a non-pruned step (pad visits included);
+        the Pallas kernel counts only KEPT buckets, so its ceiling is the
+        exact bucket count (the two engines' counters are not comparable
+        as pruning quality — parallel/ring.py ``_ring_stats``)."""
+        from mpi_cuda_largescaleknn_tpu.ops.tiled import tile_schedule_slots
+
+        if engine_name not in ("tiled", "pallas_tiled"):
+            return 0
+        num_pb = self._buckets.ids.shape[0] // self.num_shards
+        per_row = (num_pb if engine_name == "pallas_tiled"
+                   else tile_schedule_slots(num_pb))
+        return self.num_shards * qpad * per_row
+
     def _get_executable(self, qpad: int):
         """AOT executable for (active engine, qpad); compiles on miss.
 
         ``compile_count`` increments EXACTLY when XLA is invoked — the
         recompile-freedom contract the tests assert. A compiled executable
         rejects any other input shape instead of silently retracing.
-        Device-merge programs are distinct HLO from host-merge ones, so the
-        merge mode is part of the bucket key — the recompile-freedom
-        discipline holds per (engine, merge, shape) triple.
+        Device-merge programs are distinct HLO from host-merge ones, and so
+        are different query bucketings, so both are part of the bucket
+        key — the recompile-freedom discipline holds per
+        (engine, merge, shape, query_buckets) tuple.
         """
         import jax
 
-        key = (self.engine_name, self.merge_mode, qpad)
+        qb = self.query_buckets[qpad]
+        key = (self.engine_name, self.merge_mode, qpad, qb)
         exe = self._executables.get(key)
         if exe is not None:
             return exe
         with self.timers.phase(f"compile_q{qpad}"):
-            fn = self._build_query_fn(self.engine_name, qpad)
+            fn = self._build_query_fn(self.engine_name, qpad, qb)
             q0 = jax.device_put(
                 np.full((qpad, 3), PAD_SENTINEL, np.float32),
                 self._replicated)
@@ -312,12 +415,18 @@ class ResidentKnnEngine:
         return exe
 
     def warmup(self) -> dict:
-        """Compile (and once execute) every shape bucket. Returns per-bucket
-        wall-clock seconds, so the serving CLI can report what a cold start
-        cost — after this, steady-state traffic never compiles."""
+        """Compile (and once execute) every shape bucket. Returns
+        ``{"per_bucket_s": {qpad: seconds}, "query_buckets": {qpad: B},
+        "tiles_executed": int, "tiles_skipped": int}`` so the serving CLI
+        can report what a cold start cost and show the tile counters live
+        from the first line — after this, steady-state traffic never
+        compiles. (The warmup batches are all padding, so their traversals
+        prune everything: executed stays 0 and skipped counts each
+        program's full schedule — an honest first datapoint for the
+        counters.)"""
         import jax
 
-        out = {}
+        per_bucket = {}
         with self._lock:
             for qpad in self.shape_buckets:
                 t0 = time.perf_counter()
@@ -327,10 +436,24 @@ class ResidentKnnEngine:
                 q0 = jax.device_put(
                     np.full((qpad, 3), PAD_SENTINEL, np.float32),
                     self._replicated)
-                jax.block_until_ready(
-                    exe(*self._resident_args(self.engine_name), q0))
-                out[qpad] = round(time.perf_counter() - t0, 3)
-        return out
+                out = exe(*self._resident_args(self.engine_name), q0)
+                jax.block_until_ready(out)
+                self._count_tiles(int(np.asarray(out[2]).sum()),
+                                  self._tiles_possible(self.engine_name,
+                                                       qpad))
+                per_bucket[qpad] = round(time.perf_counter() - t0, 3)
+        return {"per_bucket_s": per_bucket,
+                "query_buckets": dict(self.query_buckets),
+                "tiles_executed": self.timers.counter("tiles_executed"),
+                "tiles_skipped": self.timers.counter("tiles_skipped")}
+
+    def _count_tiles(self, executed: int, possible: int) -> None:
+        """Fold one batch's measured tile count into the cumulative
+        executed/skipped counters (flat engines report 0/0)."""
+        if possible <= 0 and executed <= 0:
+            return
+        self.timers.count("tiles_executed", executed)
+        self.timers.count("tiles_skipped", max(0, possible - executed))
 
     # ----------------------------------------------------------------- degrade
 
@@ -391,16 +514,21 @@ class ResidentKnnEngine:
     def dispatch(self, queries: np.ndarray) -> _InFlightBatch:
         """Issue a batch's device traversal WITHOUT blocking on the result.
 
-        Stages + pads the batch, replicates it, and hands the AOT
-        executable call to the engine's single launch thread; the returned
-        ``_InFlightBatch`` wraps the launch future. Between ``dispatch`` and
-        ``complete`` the device crunches while the host is free to merge an
-        earlier batch (the batcher's pipelined mode) or stage the next one.
-        The lock serializes executable lookup, staging, and launch-queue
-        order with ``degrade``; it is NOT held while the device computes or
-        the host merges.
+        Morton-sorts (when enabled), stages + pads the batch, replicates
+        it, and hands the AOT executable call to the engine's single launch
+        thread; the returned ``_InFlightBatch`` wraps the launch future.
+        Between ``dispatch`` and ``complete`` the device crunches while the
+        host is free to merge an earlier batch (the batcher's pipelined
+        mode) or stage the next one. The admission sort happens OUTSIDE the
+        staged buffer's lifetime: ``queries`` is retained unsorted for
+        degradation replay, and the permutation rides the handle for
+        ``complete``'s demux. The lock serializes executable lookup,
+        staging, and launch-queue order with ``degrade``; it is NOT held
+        while the device computes or the host merges.
         """
         import jax
+
+        from mpi_cuda_largescaleknn_tpu.utils.math import morton_argsort
 
         queries = np.asarray(queries, np.float32).reshape(-1, 3)
         n = len(queries)
@@ -408,17 +536,25 @@ class ResidentKnnEngine:
             return _InFlightBatch(queries, 0, 0, self.engine_name,
                                   self.merge_mode, None, time.perf_counter())
         qpad = self.bucket_for(n)
+        perm = None
+        if self.sort_queries and n > 1:
+            with self.timers.phase("morton_sort"):
+                perm = morton_argsort(queries, self._index_lo,
+                                      self._index_hi)
+        staged = queries if perm is None else queries[perm]
         with self._lock:
             exe = self._get_executable(qpad)
             engine_name = self.engine_name
             args = self._resident_args(engine_name)
             q = np.full((qpad, 3), PAD_SENTINEL, np.float32)
-            q[:n] = queries
+            q[:n] = staged
             t0 = time.perf_counter()
             q_dev = jax.device_put(q, self._replicated)
             fut = self._launch.submit(exe, *args, q_dev)
+            possible = self._tiles_possible(engine_name, qpad)
         return _InFlightBatch(queries, n, qpad, engine_name,
-                              self.merge_mode, fut, t0)
+                              self.merge_mode, fut, t0, perm=perm,
+                              tiles_possible=possible)
 
     def complete(self, batch: _InFlightBatch):
         """Block on a dispatched batch and finish its cross-shard top-k.
@@ -427,7 +563,11 @@ class ResidentKnnEngine:
         merge them in numpy. ``merge="device"``: the reduction already ran
         in-program, so this fetches ONE final [Q] + [Q, k] pair — R x fewer
         result bytes over the host link, no merge work at all.
-        ``fetch_bytes`` / ``result_rows`` count what actually crossed.
+        ``fetch_bytes`` / ``result_rows`` count what actually crossed; the
+        per-shard tile counts ride along as an [R] i32 and feed the
+        ``tiles_executed`` / ``tiles_skipped`` counters. Finally the
+        Morton admission sort (if any) is undone, so rows come back in the
+        caller's order.
 
         The future resolution + np.asarray fetches are where async dispatch
         errors surface (a Pallas runtime failure raises HERE, not in
@@ -439,20 +579,35 @@ class ResidentKnnEngine:
         if batch.n == 0:
             return (np.zeros(0, np.float32),
                     np.zeros((0, self.k), np.int32))
-        a, b = batch.fut.result()
+        a, b, t = batch.fut.result()
         a = np.asarray(a)
         b = np.asarray(b)
         self.timers.hist("engine_batch_seconds").record(
             time.perf_counter() - batch.t0)
+        # fetch accounting covers RESULT bytes only (the PR-3 merge
+        # placement contract); the [R] i32 tile counter is observability,
+        # not payload
         self.timers.count("fetch_bytes", a.nbytes + b.nbytes)
         self.timers.count("result_rows", batch.n)
+        self._count_tiles(int(np.asarray(t).sum()), batch.tiles_possible)
         if batch.merge_mode == "device":
             dists, nbrs = a, b  # final already: [qpad], [qpad, k]
         else:
             with self.timers.phase("host_merge"):
                 dists, nbrs = _merge_shard_candidates(
                     a, b, self.num_shards, batch.qpad, self.k)
-        return dists[:batch.n], nbrs[:batch.n]
+        dists, nbrs = dists[:batch.n], nbrs[:batch.n]
+        if batch.perm is not None:
+            # undo the Morton admission sort: staged row i answers original
+            # row perm[i], so a scatter through perm restores caller order
+            # (bit-identical to the unsorted path — rows are independent
+            # and the traversal's tie order is canonical)
+            out_d = np.empty_like(dists)
+            out_n = np.empty_like(nbrs)
+            out_d[batch.perm] = dists
+            out_n[batch.perm] = nbrs
+            dists, nbrs = out_d, out_n
+        return dists, nbrs
 
     def query(self, queries: np.ndarray):
         """f32[n,3] -> (f32[n] k-th-NN distances, i32[n,k] neighbor ids).
@@ -480,8 +635,16 @@ class ResidentKnnEngine:
             "num_shards": self.num_shards,
             "bucket_size": self.bucket_size,
             "shape_buckets": list(self.shape_buckets),
-            "compiled_shapes": sorted(q for *_, q in list(self._executables)),
+            "compiled_shapes": sorted(k[2] for k in list(self._executables)),
             "compile_count": self.compile_count,
+            # query-locality surface: per-shape bucket counts, whether the
+            # Morton admission sort is on, and the traversal's cumulative
+            # tile-skip accounting (the prune's win as a number)
+            "query_buckets": {str(q): b
+                              for q, b in sorted(self.query_buckets.items())},
+            "sort_queries": self.sort_queries,
+            "tiles_executed": self.timers.counter("tiles_executed"),
+            "tiles_skipped": self.timers.counter("tiles_skipped"),
             # headline copies of the timers' counters: the stable /stats
             # API surface loadgen + serve_smoke bind to (timers.report()
             # nests the same values among phases/histograms for --timings)

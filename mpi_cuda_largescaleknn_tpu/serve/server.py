@@ -151,11 +151,20 @@ class _Handler(BaseHTTPRequestHandler):
         # engine-side cumulative counters: bytes fetched across the host
         # link and result rows completed — the device-vs-host merge
         # placement shows up as fetch_bytes/result_rows shrinking ~R x
+        # tile-skip accounting (tile-row units, serve/engine.py): executed
+        # vs skipped is the radius prune's win as a number — the locality
+        # bench's gate, and the dashboard signal that query traffic has
+        # gone spatially incoherent (skipped falling toward zero)
         for name, val in (("knn_fetch_bytes_total", e["fetch_bytes"]),
-                          ("knn_result_rows_total", e["result_rows"])):
+                          ("knn_result_rows_total", e["result_rows"]),
+                          ("knn_tiles_executed_total", e["tiles_executed"]),
+                          ("knn_tiles_skipped_total", e["tiles_skipped"])):
             lines += [f"# TYPE {name} counter", f"{name} {val}"]
         lines += ["# TYPE knn_merge_mode gauge",
                   f'knn_merge_mode{{mode="{e["merge"]}"}} 1']
+        lines += ["# TYPE knn_query_buckets gauge"] + [
+            f'knn_query_buckets{{qpad="{q}"}} {b}'
+            for q, b in e["query_buckets"].items()]
         gauges = {
             "knn_ready": int(srv.ready),
             "knn_engine_degraded": int(e["degraded_reason"] is not None),
@@ -283,12 +292,16 @@ def build_server(engine, host: str = "127.0.0.1", port: int = 8080,
 
 def serve_forever(server: KnnServer, warmup: bool = True) -> None:
     """Warm every shape bucket, mark ready, and block serving requests."""
+    eng = server.engine
     if warmup:
-        per_bucket = server.engine.warmup()
-        print(f"warmup compiles done: {per_bucket} (seconds per bucket)")
+        info = eng.warmup()
+        print(f"warmup compiles done: {info['per_bucket_s']} (seconds per "
+              f"bucket); query buckets {info['query_buckets']}; tiles "
+              f"executed/skipped {info['tiles_executed']}/"
+              f"{info['tiles_skipped']}")
     server.ready = True
     host, port = server.server_address[:2]
     print(f"serving kNN on http://{host}:{port} "
-          f"(engine={server.engine.engine_name}, "
-          f"k={server.engine.k}, n={server.engine.n_points})")
+          f"(engine={eng.engine_name}, k={eng.k}, n={eng.n_points}, "
+          f"morton_sort={'on' if eng.sort_queries else 'off'})")
     server.serve_forever()

@@ -1,0 +1,255 @@
+"""Query-locality pipeline: Morton-sorted admission + multi-bucket serving
+traversal must be BIT-IDENTICAL to the single-bucket unsorted path.
+
+The exactness contract (ISSUE 4): for the same request stream, an engine
+with ``query_buckets=1`` (no admission sort, one whole-batch query bucket —
+the pre-locality serving path) and an engine with ``query_buckets>1``
+(Morton sort + per-slice AABBs) return the same bytes after demux —
+distances AND equal-distance tie order — across shard counts, both merge
+placements, duplicate-heavy point sets, and ragged (padded) batch sizes.
+The mechanism is the canonical (dist2, id) tie discipline in
+``merge_candidates(canonical=True)`` plus the non-strict visit predicate
+(ops/tiled.py), which make the traversal's output independent of the visit
+schedule; the admission sort then demuxes through its inverse permutation.
+
+Also here: the tile-skip counters (executed + skipped == the static
+schedule ceiling; clustered batches skip more at B>1) and the AOT
+compile-count discipline with the query_buckets key component.
+"""
+
+import numpy as np
+import pytest
+
+from mpi_cuda_largescaleknn_tpu.serve.engine import ResidentKnnEngine
+from tests.oracle import assert_dist_equal, kth_nn_dist, random_points
+
+K = 4
+
+
+def _dup_points(n, seed):
+    """Duplicate-heavy point set: every base point appears ~4x, spread
+    across slab shards AND across spatial buckets within a shard, so
+    equal-distance candidates with different global ids exist for nearly
+    every query — the tie cases the canonical order must pin down."""
+    base = random_points(max(n // 4, 8), seed=seed)
+    reps = -(-n // len(base))
+    return np.tile(base, (reps, 1))[:n].copy()
+
+
+def _mesh(r):
+    from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+
+    return get_mesh(r)
+
+
+def _engine(points, r, qb, merge="host", **kw):
+    args = dict(engine="tiled", bucket_size=32, max_batch=32, min_batch=16)
+    args.update(kw)
+    return ResidentKnnEngine(points, K, mesh=_mesh(r), merge=merge,
+                             query_buckets=qb, **args)
+
+
+class TestMultiBucketBitIdentical:
+    @pytest.mark.parametrize("r", [1, 2, 4])
+    @pytest.mark.parametrize("merge", ["host", "device"])
+    def test_sorted_multibucket_equals_unsorted_b1(self, r, merge):
+        """The acceptance bar: B=auto + Morton admission == B=1 unsorted,
+        bit for bit, at R in {1, 2, 4} under both merge placements, with
+        duplicate points forcing distance ties and ragged sizes forcing
+        sentinel padding."""
+        points = _dup_points(600, seed=r)
+        base = _engine(points, r, qb=1, merge=merge)
+        multi = _engine(points, r, qb=0, merge=merge)
+        assert not base.sort_queries and multi.sort_queries
+        assert any(b > 1 for b in multi.query_buckets.values())
+        for n in (1, 5, 16, 17, 29, 32):  # ragged sizes pad up to 16/32
+            q = random_points(n, seed=100 * r + n)
+            q[: n // 2] = points[: n // 2]  # query ON duplicated points:
+            db, nb = base.query(q)         # distance-0 ties included
+            dm, nm = multi.query(q)
+            np.testing.assert_array_equal(db, dm)
+            np.testing.assert_array_equal(nb, nm)
+            assert_dist_equal(dm, kth_nn_dist(q, points, K))
+
+    def test_explicit_query_buckets_equal_too(self):
+        """Any B produces the same bytes — not just auto: the canonical
+        tie order is bucket-geometry independent."""
+        points = _dup_points(500, seed=9)
+        engines = [_engine(points, 4, qb) for qb in (1, 2, 4, 0)]
+        q = random_points(32, seed=5)
+        q[:16] = points[:16]
+        want = engines[0].query(q)
+        for eng in engines[1:]:
+            got = eng.query(q)
+            np.testing.assert_array_equal(want[0], got[0])
+            np.testing.assert_array_equal(want[1], got[1])
+
+    def test_scattered_then_identical_rows_demux(self):
+        """Rows that are permutations of each other demux identically:
+        the same queries in two different request orders return
+        row-aligned identical answers (the inverse-permutation scatter)."""
+        points = random_points(400, seed=3)
+        eng = _engine(points, 4, qb=0)
+        q = random_points(24, seed=8)
+        perm = np.random.default_rng(0).permutation(len(q))
+        d1, n1 = eng.query(q)
+        d2, n2 = eng.query(q[perm])
+        np.testing.assert_array_equal(d1[perm], d2)
+        np.testing.assert_array_equal(n1[perm], n2)
+
+    def test_max_radius_underfull_rows_match(self):
+        """Under-full heaps (max_radius cutoff): the untouched r^2 / -1
+        slots must stay bit-identical across bucketings."""
+        points = random_points(400, seed=3)
+        base = _engine(points, 4, qb=1, max_radius=0.05)
+        multi = _engine(points, 4, qb=4, max_radius=0.05)
+        q = random_points(24, seed=7)
+        db, nb = base.query(q)
+        dm, nm = multi.query(q)
+        np.testing.assert_array_equal(db, dm)
+        np.testing.assert_array_equal(nb, nm)
+
+
+class TestTileAccounting:
+    def test_executed_plus_skipped_is_the_schedule(self):
+        """Per batch, executed + skipped tile-rows == the program's static
+        ceiling (num_shards * qpad * schedule slots) — the counters are an
+        exact partition of the schedule, not estimates."""
+        from mpi_cuda_largescaleknn_tpu.ops.tiled import tile_schedule_slots
+
+        points = random_points(600, seed=1)
+        eng = _engine(points, 2, qb=0)
+        q = random_points(20, seed=2)
+        eng.query(q)
+        s = eng.stats()
+        qpad = eng.bucket_for(20)
+        num_pb = eng._buckets.ids.shape[0] // eng.num_shards
+        ceiling = eng.num_shards * qpad * tile_schedule_slots(num_pb)
+        assert s["tiles_executed"] + s["tiles_skipped"] == ceiling
+        assert s["tiles_executed"] > 0
+
+    def test_blob_mixture_batch_skips_vs_b1(self):
+        """The locality claim at engine granularity, deterministically: a
+        batch MIXING several tight blobs (what the batcher coalesces from
+        per-user requests) executes far fewer tiles on the multi-bucket
+        engine — the Morton sort separates the blobs into buckets with
+        tiny radii — while the B=1 engine's single AABB spans all blobs
+        and degenerates toward the scattered case (same seeds, counters
+        only, no timing)."""
+        rng = np.random.default_rng(0)
+        points = rng.random((4096, 3)).astype(np.float32)
+        multi = _engine(points, 1, qb=0, bucket_size=64, max_batch=128,
+                        min_batch=16)
+        b1 = _engine(points, 1, qb=1, bucket_size=64, max_batch=128,
+                     min_batch=16)
+        centers = rng.random((8, 3))
+        mixture = np.clip(
+            centers[np.arange(128) % 8] + rng.normal(0, 0.02, (128, 3)),
+            0, 1).astype(np.float32)
+        scattered = rng.random((128, 3)).astype(np.float32)
+
+        def tiles_for(eng, q):
+            before = eng.timers.counter("tiles_executed")
+            eng.query(q)
+            return eng.timers.counter("tiles_executed") - before
+
+        mc, ms = tiles_for(multi, mixture), tiles_for(multi, scattered)
+        bc, bs = tiles_for(b1, mixture), tiles_for(b1, scattered)
+        assert mc < ms, (mc, ms)
+        assert 2 * mc <= bc, (mc, bc)  # the bench's <= 0.5x claim
+        assert ms <= bs, (ms, bs)
+
+    def test_flat_engine_counts_nothing(self):
+        points = random_points(200, seed=4)
+        eng = ResidentKnnEngine(points, K, mesh=_mesh(2),
+                                engine="bruteforce", max_batch=16,
+                                min_batch=16)
+        eng.query(random_points(8, seed=1))
+        s = eng.stats()
+        assert s["tiles_executed"] == 0 and s["tiles_skipped"] == 0
+        assert s["query_buckets"] == {"16": 1}
+
+
+class TestCompileDiscipline:
+    def test_warmup_compiles_one_program_per_bucket(self):
+        """query_buckets resolves per qpad INSIDE the AOT key, so warmup
+        still compiles exactly len(shape_buckets) programs and ragged
+        traffic adds zero — the recompile-freedom contract."""
+        points = random_points(500, seed=6)
+        eng = _engine(points, 4, qb=0, max_batch=64)
+        info = eng.warmup()
+        assert eng.compile_count == len(eng.shape_buckets)
+        assert set(info["per_bucket_s"]) == set(eng.shape_buckets)
+        assert info["query_buckets"] == dict(eng.query_buckets)
+        # all-pad warmup traversals prune everything: honest first counters
+        assert info["tiles_executed"] == 0
+        assert info["tiles_skipped"] > 0
+        for n in (1, 3, 16, 17, 31, 64):
+            eng.query(random_points(n, seed=n))
+        assert eng.compile_count == len(eng.shape_buckets)
+
+    def test_resolver_properties(self):
+        from mpi_cuda_largescaleknn_tpu.parallel.ring import (
+            resolve_query_buckets,
+        )
+
+        for qpad in (8, 16, 32, 64, 128, 1024):
+            for k in (1, 4, 16, 100):
+                for setting in (0, 1, 3, 8, 1 << 20):
+                    b = resolve_query_buckets(setting, qpad, k)
+                    assert qpad % b == 0, (qpad, k, setting, b)
+                    assert qpad // b >= 8 or b == 1
+        assert resolve_query_buckets(1, 128, 16) == 1     # explicit off
+        assert resolve_query_buckets(3, 128, 16) == 4     # rounds to pow2
+        assert resolve_query_buckets(0, 8, 16) == 1       # tiny batch
+        assert resolve_query_buckets(0, 128, 16) == 8     # ~k per bucket
+
+
+class TestServedEndToEnd:
+    def test_concurrent_clients_through_sorted_server(self):
+        """Full stack at query_buckets=auto, pipeline depth 2: concurrent
+        clients' rows come back in caller order (inverse-permutation demux
+        crosses the batcher's coalescing) and oracle-exact."""
+        import json
+        import threading
+        import urllib.request
+
+        from mpi_cuda_largescaleknn_tpu.serve.server import build_server
+
+        points = _dup_points(800, seed=11)
+        eng = _engine(points, 4, qb=0, max_batch=128)
+        eng.warmup()
+        srv = build_server(eng, port=0, max_delay_s=0.002, pipeline_depth=2)
+        srv.ready = True
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        results = {}
+
+        def client(i):
+            q = random_points(5 + 3 * i, seed=300 + i)
+            req = urllib.request.Request(
+                base + "/knn",
+                data=json.dumps({"queries": q.tolist(),
+                                 "neighbors": True}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                results[i] = (q, json.loads(resp.read()))
+
+        try:
+            ths = [threading.Thread(target=client, args=(i,))
+                   for i in range(6)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+            assert len(results) == 6
+            for q, resp in results.values():
+                assert_dist_equal(np.asarray(resp["dists"], np.float32),
+                                  kth_nn_dist(q, points, K))
+            m = urllib.request.urlopen(base + "/metrics",
+                                       timeout=10).read().decode()
+            assert "# TYPE knn_tiles_executed_total counter" in m
+            assert "# TYPE knn_tiles_skipped_total counter" in m
+            assert 'knn_query_buckets{qpad="128"}' in m
+        finally:
+            srv.close()

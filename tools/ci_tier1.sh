@@ -14,7 +14,11 @@ set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu pyt
 # shared CI boxes are trajectory data, not a pass/fail bar. SERVE_BENCH=0
 # skips (e.g. when iterating on an unrelated subsystem).
 if [ "${SERVE_BENCH:-1}" != "0" ] && [ "$rc" -ne 124 ]; then
-  timeout -k 10 600 python tools/serve_smoke.py --duration 2 --trials 3 \
+  # --locality-bench adds the clustered-vs-uniform query-locality section
+  # (locality_compare): Morton admission + multi-bucket traversal vs the
+  # single-bucket baseline, gated on oracle-exactness like the rest
+  timeout -k 10 900 python tools/serve_smoke.py --duration 2 --trials 3 \
+      --locality-bench \
       --out BENCH_serve.json >/dev/null || { brc=$?; [ "$rc" -eq 0 ] && rc=$brc; }
 fi
 exit $rc

@@ -9,8 +9,18 @@ shedding) actually shows. Both report q/s, rows/s and p50/p95/p99 from the
 same obs/timers.py LatencyHistogram the server exports on /metrics, so
 client-side and server-side percentiles line up bucket-for-bucket.
 
+Workloads (``--workload``): ``uniform`` draws every query independently in
+[0, scale)^3 — spatially incoherent traffic, the radius prune's worst case.
+``clustered`` draws ``--blobs`` Gaussian blob centers from the same box
+(``--scale`` stands in for the index bounding box — match it to the data)
+and each REQUEST samples one blob with ``--blob-sigma`` spread: the
+one-user-one-region pattern the serving engine's Morton-sorted multi-bucket
+traversal exists to exploit (``serve_smoke.py --locality-bench`` drives
+both and compares tile counts).
+
     python tools/loadgen.py --url http://127.0.0.1:8080 --duration 10 \
-        --concurrency 8 --batch 16 [--qps 500] [--neighbors] [--out rep.json]
+        --concurrency 8 --batch 16 [--qps 500] [--workload clustered] \
+        [--neighbors] [--out rep.json]
 """
 
 from __future__ import annotations
@@ -125,6 +135,12 @@ def _server_pipeline_stats(url: str, timeout_s: float) -> dict | None:
         "merge": stats.get("engine", {}).get("merge"),
         "fetch_bytes": stats.get("engine", {}).get("fetch_bytes"),
         "result_rows": stats.get("engine", {}).get("result_rows"),
+        # query-locality surface: bucketing config + tile-skip counters
+        # (tile-row units) — the locality bench's primary signal
+        "query_buckets": stats.get("engine", {}).get("query_buckets"),
+        "sort_queries": stats.get("engine", {}).get("sort_queries"),
+        "tiles_executed": stats.get("engine", {}).get("tiles_executed"),
+        "tiles_skipped": stats.get("engine", {}).get("tiles_skipped"),
     }
 
 
@@ -132,7 +148,8 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
              batch: int = 8, qps: float = 0.0, neighbors: bool = False,
              timeout_s: float = 10.0, seed: int = 0,
              scale: float = 1.0, server_stats: bool = False,
-             binary: bool = False) -> dict:
+             binary: bool = False, workload: str = "uniform",
+             blobs: int = 16, blob_sigma: float = 0.02) -> dict:
     """Drive the server; returns the JSON-able report (also the test API).
 
     ``qps > 0`` switches to open loop: the request schedule is fixed at
@@ -141,8 +158,21 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
     the offered load. ``server_stats`` appends a post-run /stats scrape of
     the server's pipeline occupancy (depth, stalls, mean batch width) so
     one artifact carries both sides of a throughput run.
+
+    ``workload="clustered"`` draws each request's queries from one of
+    ``blobs`` Gaussian blobs (centers uniform in the [0, scale)^3 box,
+    per-axis sigma ``blob_sigma * scale``, samples clipped to the box);
+    concurrent workers hit different blobs, so a coalesced server batch
+    mixes a few tight clusters — the locality pattern the engine's Morton
+    admission separates back out.
     """
-    rng = np.random.default_rng(seed)
+    if workload not in ("uniform", "clustered"):
+        raise ValueError(f"unknown workload '{workload}'")
+    # blob centers are seed-deterministic and shared by all workers; each
+    # request picks a blob, so the stream is a mixture of tight clusters.
+    # Query draws use a PER-WORKER Generator (numpy Generators are not
+    # thread-safe — concurrent draws from a shared one can corrupt state)
+    centers = np.random.default_rng(seed).random((max(1, blobs), 3)) * scale
     hist = LatencyHistogram()
     lock = threading.Lock()
     counts = {"ok": 0, "overload": 0, "deadline": 0, "http_error": 0,
@@ -162,8 +192,13 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
             else:
                 counts["http_error"] += 1
 
-    def one_request(client: _Client):
-        q = (rng.random((batch, 3)) * scale).astype(np.float32)
+    def one_request(client: _Client, rng: np.random.Generator):
+        if workload == "clustered":
+            c = centers[rng.integers(len(centers))]
+            q = np.clip(c + rng.normal(0.0, blob_sigma * scale, (batch, 3)),
+                        0.0, scale).astype(np.float32)
+        else:
+            q = (rng.random((batch, 3)) * scale).astype(np.float32)
         t0 = time.perf_counter()
         try:
             status = client.post_batch(q, neighbors, binary)
@@ -173,17 +208,19 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
             with lock:
                 counts["net_error"] += 1
 
-    def closed_worker():
+    def closed_worker(wid: int):
         client = _Client(url, timeout_s)
+        wrng = np.random.default_rng((seed, wid))
         try:
             while time.monotonic() < stop_at:
-                one_request(client)
+                one_request(client, wrng)
         finally:
             client.close()
 
     def open_worker(wid: int):
         # worker wid owns schedule slots wid, wid+W, wid+2W, ...
         client = _Client(url, timeout_s)
+        wrng = np.random.default_rng((seed, wid))
         interval = concurrency / qps
         next_t = time.monotonic() + (wid / qps)
         try:
@@ -199,7 +236,7 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
                     with lock:
                         counts["sched_skipped"] += missed
                     continue
-                one_request(client)
+                one_request(client, wrng)
                 next_t += interval
         finally:
             client.close()
@@ -207,7 +244,7 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
     t_start = time.monotonic()
     workers = [threading.Thread(
         target=(open_worker if qps > 0 else closed_worker),
-        args=((i,) if qps > 0 else ()), daemon=True)
+        args=(i,), daemon=True)
         for i in range(concurrency)]
     for w in workers:
         w.start()
@@ -222,6 +259,9 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
         **({"server": _server_pipeline_stats(url, timeout_s)}
            if server_stats else {}),
         "mode": "open" if qps > 0 else "closed",
+        "workload": workload,
+        **({"blobs": blobs, "blob_sigma": blob_sigma}
+           if workload == "clustered" else {}),
         "url": url, "duration_s": round(elapsed, 3),
         "concurrency": concurrency, "batch": batch, "binary": binary,
         "offered_qps": qps if qps > 0 else None,
@@ -252,7 +292,17 @@ def main(argv=None) -> int:
     ap.add_argument("--timeout", type=float, default=10.0)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--scale", type=float, default=1.0,
-                    help="queries uniform in [0, scale)^3")
+                    help="query box [0, scale)^3 (match the index bbox)")
+    ap.add_argument("--workload", choices=("uniform", "clustered"),
+                    default="uniform",
+                    help="uniform: every query independent in the box; "
+                         "clustered: each request samples one Gaussian "
+                         "blob (query locality)")
+    ap.add_argument("--blobs", type=int, default=16,
+                    help="clustered: number of blob centers in the box")
+    ap.add_argument("--blob-sigma", type=float, default=0.02,
+                    help="clustered: per-axis blob sigma as a fraction "
+                         "of --scale")
     ap.add_argument("--server-stats", action="store_true",
                     help="embed a post-run /stats pipeline-occupancy scrape")
     ap.add_argument("--out", default=None, help="write JSON report here")
@@ -261,7 +311,9 @@ def main(argv=None) -> int:
     report = run_load(a.url, duration_s=a.duration, concurrency=a.concurrency,
                       batch=a.batch, qps=a.qps, neighbors=a.neighbors,
                       timeout_s=a.timeout, seed=a.seed, scale=a.scale,
-                      server_stats=a.server_stats, binary=a.binary)
+                      server_stats=a.server_stats, binary=a.binary,
+                      workload=a.workload, blobs=a.blobs,
+                      blob_sigma=a.blob_sigma)
     text = json.dumps(report, indent=2)
     print(text)
     if a.out:

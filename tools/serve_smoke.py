@@ -1,6 +1,10 @@
 #!/usr/bin/env python3
 """Serving bench smoke: loadgen q/s + p50/p95/p99 at pipeline depth 1 vs 2,
-plus merge=host vs merge=device at depth 2.
+plus merge=host vs merge=device at depth 2, plus (``--locality-bench``) the
+query-locality comparison — clustered vs uniform workloads at
+query_buckets 1 vs auto, gated on deterministic tile-skip accounting
+(``locality_compare`` in BENCH_serve.json; tools/ci_tier1.sh passes the
+flag).
 
 Boots the full serving stack in-process on a CPU fixture (default: one
 virtual device, single-threaded Eigen, tiled engine — one core per
@@ -72,7 +76,8 @@ def _setup_cpu_fixture(devices: int) -> None:
 import numpy as np  # noqa: E402
 
 
-def _run_loadgen(base_url, *, duration_s, concurrency, batch, seed) -> dict:
+def _run_loadgen(base_url, *, duration_s, concurrency, batch, seed,
+                 workload="uniform", blobs=8, blob_sigma=0.02) -> dict:
     """Drive tools/loadgen.py as a SUBPROCESS: the client's request work
     must not share this interpreter's GIL with the server's handler,
     batcher, and merge threads, or the measurement throttles the thing it
@@ -88,7 +93,8 @@ def _run_loadgen(base_url, *, duration_s, concurrency, batch, seed) -> dict:
             [sys.executable, loadgen, "--url", base_url,
              "--duration", str(duration_s), "--concurrency", str(concurrency),
              "--batch", str(batch), "--seed", str(seed), "--server-stats",
-             "--binary", "--out", out_path],
+             "--binary", "--workload", workload, "--blobs", str(blobs),
+             "--blob-sigma", str(blob_sigma), "--out", out_path],
             check=True, stdout=subprocess.DEVNULL, timeout=duration_s + 120)
         with open(out_path) as f:
             return json.load(f)
@@ -267,6 +273,107 @@ def run_merge_bench(*, n_points=8192, k=16, devices=4, duration_s=2.0,
     return out
 
 
+def run_locality_bench(*, n_points=8192, k=16, duration_s=2.0,
+                       concurrency=8, batch=16, max_batch=128,
+                       max_delay_s=0.008, blobs=8, blob_sigma=0.02,
+                       trials=2, seed=0) -> dict:
+    """query_buckets=1 (unsorted single-bucket, the pre-locality serving
+    path) vs query_buckets=auto (Morton admission + multi-bucket traversal)
+    on clustered AND uniform workloads, pipeline depth 2, one CPU device.
+
+    The headline numbers are DETERMINISTIC tile accounting, not timings:
+    ``tiles_per_row`` = executed tile-rows / result rows from the engine's
+    own counters (each engine config runs in its own ResidentKnnEngine, so
+    the deltas are per-run exact). The locality claim is
+    ``tiles_ratio_clustered = auto/b1 <= 0.5`` — the multi-bucket prune
+    does less than half the tile work on coherent traffic — with
+    ``qps_ratio_uniform >= ~0.95`` showing the sort+bucketing costs
+    nothing on incoherent traffic (q/s on shared boxes is trajectory data;
+    only oracle-exactness gates the exit code)."""
+    _setup_cpu_fixture(1)
+    from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+    from mpi_cuda_largescaleknn_tpu.serve.engine import ResidentKnnEngine
+    from mpi_cuda_largescaleknn_tpu.serve.server import build_server
+
+    rng = np.random.default_rng(seed)
+    points = rng.random((n_points, 3)).astype(np.float32)
+    mesh = get_mesh(1)
+    engines = {}
+    for cfg, qb in (("b1", 1), ("auto", 0)):
+        engines[cfg] = ResidentKnnEngine(
+            points, k, mesh=mesh, engine="tiled", bucket_size=64,
+            max_batch=max_batch, min_batch=16, query_buckets=qb)
+        engines[cfg].warmup()
+
+    def one_trial(cfg, workload, trial):
+        eng = engines[cfg]
+        srv = build_server(eng, port=0, max_delay_s=max_delay_s,
+                           pipeline_depth=2)
+        srv.ready = True
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        try:
+            exact = _probe_oracle_exact(base, points, k, seed)
+            before = eng.timers.counters_snapshot()
+            rep = _run_loadgen(base, duration_s=duration_s,
+                               concurrency=concurrency, batch=batch,
+                               seed=seed + trial, workload=workload,
+                               blobs=blobs, blob_sigma=blob_sigma)
+            after = eng.timers.counters_snapshot()
+            rep["oracle_exact"] = exact
+            for c in ("tiles_executed", "tiles_skipped", "result_rows"):
+                rep[c] = after.get(c, 0) - before.get(c, 0)
+            return rep
+        finally:
+            srv.close()
+
+    one_trial("b1", "uniform", trials)  # cold-start burn (see run_smoke)
+    runs = {(cfg, wl): [] for cfg in engines for wl in ("clustered",
+                                                        "uniform")}
+    for trial in range(trials):
+        for cfg in engines:
+            for wl in ("clustered", "uniform"):
+                runs[(cfg, wl)].append(one_trial(cfg, wl, trial))
+
+    per_config = {}
+    for cfg, eng in engines.items():
+        per_config[cfg] = {"query_buckets": dict(eng.query_buckets),
+                           "sort_queries": eng.sort_queries}
+        for wl in ("clustered", "uniform"):
+            reps = runs[(cfg, wl)]
+            med = sorted(reps, key=lambda r: r["qps"])[len(reps) // 2]
+            rows = sum(r["result_rows"] for r in reps)
+            tiles = sum(r["tiles_executed"] for r in reps)
+            per_config[cfg][wl] = {
+                "qps": med["qps"], "p99_ms": med["p99_ms"],
+                "qps_trials": [r["qps"] for r in reps],
+                "oracle_exact": all(r["oracle_exact"] for r in reps),
+                "tiles_executed": tiles,
+                "tiles_skipped": sum(r["tiles_skipped"] for r in reps),
+                "result_rows": rows,
+                "tiles_per_row": round(tiles / max(1, rows), 2),
+            }
+
+    out = {
+        "kind": "serve_locality_bench", "n_points": n_points, "k": k,
+        "devices": 1, "pipeline_depth": 2, "duration_s": duration_s,
+        "concurrency": concurrency, "batch": batch, "blobs": blobs,
+        "blob_sigma": blob_sigma, "trials": trials,
+        "tile_units": "tile-rows (query row x point-tile visit)",
+        "per_config": per_config,
+    }
+    b1, auto = per_config["b1"], per_config["auto"]
+    for wl in ("clustered", "uniform"):
+        if b1[wl]["tiles_per_row"]:
+            out[f"tiles_ratio_{wl}"] = round(
+                auto[wl]["tiles_per_row"] / b1[wl]["tiles_per_row"], 3)
+        if b1[wl]["qps"]:
+            out[f"qps_ratio_{wl}"] = round(
+                auto[wl]["qps"] / b1[wl]["qps"], 3)
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--points", type=int, default=8192)
@@ -292,7 +399,27 @@ def main(argv=None) -> int:
                     help="internal: run ONLY the merge bench in this "
                          "process (needs its own virtual device count) "
                          "and print its JSON")
+    ap.add_argument("--locality-bench", action="store_true",
+                    help="also run the query-locality bench (clustered vs "
+                         "uniform workloads at query_buckets 1 vs auto) in "
+                         "a subprocess and embed locality_compare")
+    ap.add_argument("--locality-child", action="store_true",
+                    help="internal: run ONLY the locality bench in this "
+                         "process (needs its own 1-device fixture) and "
+                         "print its JSON")
     a = ap.parse_args(argv)
+
+    if a.locality_child:
+        report = run_locality_bench(
+            n_points=a.points, k=a.k, duration_s=a.duration,
+            concurrency=a.concurrency, batch=min(a.batch, 16),
+            trials=max(1, a.trials - 1), max_delay_s=a.max_delay_ms / 1e3,
+            seed=a.seed)
+        print(json.dumps(report, indent=2))
+        ok = all(report["per_config"][c][w]["oracle_exact"]
+                 for c in report["per_config"]
+                 for w in ("clustered", "uniform"))
+        return 0 if ok else 1
 
     if a.merge_bench:
         report = run_merge_bench(
@@ -310,16 +437,15 @@ def main(argv=None) -> int:
                        batch=a.batch, trials=a.trials, devices=a.devices,
                        max_delay_s=a.max_delay_ms / 1e3, seed=a.seed)
     ok = all(r.get("oracle_exact") for r in report["per_depth"].values())
+    # child benches need their own virtual device counts and the count is
+    # frozen at this process's first jax import — strip this process's
+    # fixture flags so each child's _setup_cpu_fixture can pin its own
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+        and "xla_cpu_multi_thread_eigen" not in f).strip()
     if a.merge_devices > 0:
-        # subprocess: the merge bench needs an R-device mesh and the
-        # virtual device count is frozen at this process's first jax
-        # import — strip this process's fixture flags so the child's
-        # _setup_cpu_fixture can pin its own count
-        env = dict(os.environ)
-        env["XLA_FLAGS"] = " ".join(
-            f for f in env.get("XLA_FLAGS", "").split()
-            if "xla_force_host_platform_device_count" not in f
-            and "xla_cpu_multi_thread_eigen" not in f).strip()
         try:
             child = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--merge-bench",
@@ -349,6 +475,38 @@ def main(argv=None) -> int:
                 detail = (raw.decode(errors="replace")
                           if isinstance(raw, bytes) else str(raw))[-1500:]
             report["merge_compare"] = {"error": f"{str(e)[:300]} :: {detail}"}
+    if a.locality_bench:
+        # same subprocess discipline as the merge bench: the locality
+        # child pins a 1-device single-thread-Eigen fixture of its own.
+        # Oracle-exactness is the only exit-code gate; the tile/q-s ratios
+        # are the trajectory numbers the BENCH series tracks.
+        try:
+            child = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--locality-child",
+                 "--points", str(a.points), "--k", str(a.k),
+                 "--duration", str(a.duration),
+                 "--concurrency", str(a.concurrency),
+                 "--batch", str(a.batch), "--trials", str(a.trials),
+                 "--max-delay-ms", str(a.max_delay_ms),
+                 "--seed", str(a.seed)],
+                capture_output=True, text=True, env=env,
+                timeout=180 + a.duration * (a.trials + 2) * 6)
+            lc = json.loads(child.stdout)
+            report["locality_compare"] = lc
+            ok = ok and all(
+                lc["per_config"][c][w].get("oracle_exact")
+                for c in lc.get("per_config", {})
+                for w in ("clustered", "uniform"))
+        except (subprocess.TimeoutExpired, json.JSONDecodeError) as e:
+            if isinstance(e, json.JSONDecodeError):
+                detail = (child.stderr or child.stdout or "")[-1500:]
+            else:
+                raw = e.stderr or e.stdout or b""
+                detail = (raw.decode(errors="replace")
+                          if isinstance(raw, bytes) else str(raw))[-1500:]
+            report["locality_compare"] = {
+                "error": f"{str(e)[:300]} :: {detail}"}
     text = json.dumps(report, indent=2)
     print(text)
     if a.out:
