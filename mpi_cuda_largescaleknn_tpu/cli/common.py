@@ -32,6 +32,11 @@ TPU-side options (no reference analogue):
   --query-chunk N   stream queries in chunks of N rows per device;
                     bounds candidate-heap memory to N*k per device for runs
                     whose heaps exceed HBM (e.g. -k 100 at 100M+ points)
+  --merge M         chunked runs: host | device | auto — where the
+                    cross-shard top-k merge runs (device keeps it inside
+                    the SPMD program on the global mesh axis and fetches
+                    final rows only; auto = device on power-of-two meshes,
+                    host with a logged warning otherwise)
   --profile-dir D   write a jax.profiler trace
   --timings         print phase timings as JSON to stderr
   --checkpoint-dir D  snapshot exchange state between rounds (both
@@ -73,6 +78,7 @@ def parse_args(program: str, argv: list[str]):
               "profile_dir": None,
               "timings": False, "checkpoint_dir": None, "checkpoint_every": 1,
               "write_indices": None, "query_chunk": 0, "selfcheck": 0,
+              "merge": "host",
               "coordinator": None, "num_hosts": 1, "host_id": 0}
     i = 0
     try:
@@ -112,6 +118,8 @@ def parse_args(program: str, argv: list[str]):
                 i += 1; extras["write_indices"] = argv[i]
             elif arg == "--query-chunk":
                 i += 1; extras["query_chunk"] = int(argv[i])
+            elif arg == "--merge":
+                i += 1; extras["merge"] = argv[i]
             elif arg == "--selfcheck":
                 i += 1; extras["selfcheck"] = int(argv[i])
             elif arg == "--coordinator":
@@ -140,6 +148,7 @@ def parse_args(program: str, argv: list[str]):
                     point_group=extras["point_group"],
                     num_shards=extras["shards"] or 0,
                     query_chunk=extras["query_chunk"],
+                    merge=extras["merge"],
                     profile_dir=extras["profile_dir"],
                     checkpoint_dir=extras["checkpoint_dir"],
                     checkpoint_every=extras["checkpoint_every"])

@@ -39,36 +39,9 @@ from mpi_cuda_largescaleknn_tpu.parallel.mesh import (
     AXIS,
     get_mesh,
     initialize_distributed,
+    my_mesh_positions as _my_mesh_positions,
 )
 from mpi_cuda_largescaleknn_tpu.parallel.ring import ring_knn
-
-
-def _my_mesh_positions(mesh) -> list[int]:
-    """Mesh positions whose devices this process hosts (ascending, so the
-    concatenated local block matches global index order).
-
-    Validates — identically on EVERY host, before any collective — that each
-    launched process owns at least one mesh position. When --shards is
-    smaller than the pod's device count, ``get_mesh`` takes a device prefix
-    and can exclude every device of some process; that host would then feed
-    an empty block to ``make_array_from_process_local_data`` while the
-    others block forever inside the collective — a silent distributed hang.
-    Raising the same error everywhere turns it into a clean failure."""
-    import jax
-
-    mesh_devs = list(mesh.devices.ravel())
-    owners = {d.process_index for d in mesh_devs}
-    missing = sorted(set(range(jax.process_count())) - owners)
-    if missing:
-        raise RuntimeError(
-            f"mesh of {len(mesh_devs)} device(s) excludes all devices of "
-            f"process(es) {missing} of {jax.process_count()}; every launched "
-            "process must own at least one mesh position — increase --shards "
-            "(or the partition-file count) or launch fewer hosts")
-    my_pos = [i for i, d in enumerate(mesh_devs)
-              if d.process_index == jax.process_index()]
-    assert my_pos == sorted(my_pos)
-    return my_pos
 
 
 def run_unordered_multihost(cfg: KnnConfig, in_path: str, out_path: str,
@@ -126,6 +99,7 @@ def run_unordered_multihost(cfg: KnnConfig, in_path: str, out_path: str,
             max_radius=cfg.max_radius, engine=cfg.engine,
             query_tile=cfg.query_tile, point_tile=cfg.point_tile,
             bucket_size=cfg.bucket_size, point_group=cfg.point_group,
+            merge=cfg.merge,
             checkpoint_dir=cfg.checkpoint_dir,
             checkpoint_every=cfg.checkpoint_every)
     else:

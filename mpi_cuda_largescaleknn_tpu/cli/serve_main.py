@@ -56,6 +56,15 @@ SERVE_FLAGS = """
                     (first request per bucket then pays the compile)
   --timings         print engine phase timings as JSON on shutdown
   --verbose         log each HTTP request to stderr
+
+Multi-host (pod) mode — launch ONE copy per host with the same args plus
+--host-id; the processes join one global device mesh (jax.distributed, the
+batch CLIs' lifecycle) and each serves its 1/R slice of the pod-final
+answer over POST /shard_knn to the pod front end
+(python -m mpi_cuda_largescaleknn_tpu.serve.frontend --hosts ...):
+  --coordinator A   coordinator address host:port
+  --num-hosts N     number of cooperating serving processes
+  --host-id I       this process's id in [0, N)
 """
 
 
@@ -74,7 +83,8 @@ def parse_serve_args(argv: list[str]) -> dict:
            "max_delay_ms": 2.0, "pipeline_depth": 2,
            "max_queue_rows": 4096,
            "timeout_ms": 5000.0, "warmup": True, "timings": False,
-           "verbose": False}
+           "verbose": False,
+           "coordinator": None, "num_hosts": 1, "host_id": 0}
     i = 0
     try:
         while i < len(argv):
@@ -111,6 +121,12 @@ def parse_serve_args(argv: list[str]) -> dict:
                 i += 1; opt["max_queue_rows"] = int(argv[i])
             elif arg == "--timeout-ms":
                 i += 1; opt["timeout_ms"] = float(argv[i])
+            elif arg == "--coordinator":
+                i += 1; opt["coordinator"] = argv[i]
+            elif arg == "--num-hosts":
+                i += 1; opt["num_hosts"] = int(argv[i])
+            elif arg == "--host-id":
+                i += 1; opt["host_id"] = int(argv[i])
             elif arg == "--no-warmup":
                 opt["warmup"] = False
             elif arg == "--timings":
@@ -134,12 +150,22 @@ def main(argv: list[str] | None = None) -> int:
     enable_persistent_cache()
 
     from mpi_cuda_largescaleknn_tpu.io.reader import read_points
-    from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+    from mpi_cuda_largescaleknn_tpu.parallel.mesh import (
+        get_mesh,
+        initialize_distributed,
+    )
     from mpi_cuda_largescaleknn_tpu.serve.engine import ResidentKnnEngine
     from mpi_cuda_largescaleknn_tpu.serve.server import (
         build_server,
         serve_forever,
     )
+
+    if opt["num_hosts"] > 1:
+        # pod mode: join the global mesh BEFORE any device query — the
+        # engine below then builds over all hosts' devices and its AOT
+        # query programs are pod-wide collectives (serve/frontend.py)
+        initialize_distributed(opt["coordinator"], opt["num_hosts"],
+                               opt["host_id"])
 
     points = read_points(opt["in_path"])
     print(f"loaded {len(points)} points from {opt['in_path']}")
@@ -149,6 +175,31 @@ def main(argv: list[str] | None = None) -> int:
         max_radius=opt["max_radius"], max_batch=opt["max_batch"],
         min_batch=opt["min_batch"], merge=opt["merge"],
         query_buckets=opt["query_buckets"])
+
+    if opt["num_hosts"] > 1:
+        from mpi_cuda_largescaleknn_tpu.serve.frontend import HostSliceServer
+
+        server = HostSliceServer((opt["host"], opt["port"]), engine,
+                                 verbose=opt["verbose"])
+        try:
+            if opt["warmup"]:
+                # collective: every host compiles+executes the same bucket
+                # sequence in lock-step before any fan-out traffic lands
+                info = engine.warmup()
+                print(f"warmup compiles done: {info['per_bucket_s']}")
+            server.ready = True
+            host, port = server.server_address[:2]
+            print(f"serving pod slice {engine.process_index}/"
+                  f"{engine.process_count} on http://{host}:{port} "
+                  f"(mesh positions {engine.stats()['my_positions']})")
+            server.serve_forever()
+        except KeyboardInterrupt:
+            print("shutting down")
+        finally:
+            server.close()
+            if opt["timings"]:
+                sys.stderr.write(engine.timers.dump() + "\n")
+        return 0
     server = build_server(
         engine, host=opt["host"], port=opt["port"],
         max_delay_s=opt["max_delay_ms"] / 1e3,

@@ -46,6 +46,12 @@ class KnnConfig:
                                      # many rows/device (bounds heap memory
                                      # to chunk*k per device — the k=100 /
                                      # beyond-HBM regime)
+    merge: str = "host"              # chunked runs: cross-shard top-k merge
+                                     # placement — "host" (the ring),
+                                     # "device" (replicate-traverse-merge,
+                                     # reduction in-program on the global
+                                     # mesh axis), "auto" (device on
+                                     # power-of-two meshes)
     profile_dir: str | None = None   # jax.profiler trace output
     checkpoint_dir: str | None = None  # ring-state checkpoint/resume
     checkpoint_every: int = 1        # rounds between snapshots
@@ -57,6 +63,9 @@ class KnnConfig:
         if self.engine not in ("auto", "tiled", "pallas_tiled", "bruteforce",
                                "tree", "pallas"):
             raise ValueError(f"unknown engine '{self.engine}'")
+        if self.merge not in ("host", "device", "auto"):
+            raise ValueError(f"unknown merge mode '{self.merge}' "
+                             "(expected host | device | auto)")
         pg = self.point_group
         if pg < 0 or (pg and (pg & (pg - 1)) != 0):
             raise ValueError(

@@ -80,7 +80,7 @@ class UnorderedKNN:
                     engine=cfg.engine, query_tile=cfg.query_tile,
                     point_tile=cfg.point_tile, bucket_size=cfg.bucket_size,
                     point_group=cfg.point_group,
-                    chunk_rows=cfg.query_chunk,
+                    chunk_rows=cfg.query_chunk, merge=cfg.merge,
                     checkpoint_dir=cfg.checkpoint_dir,
                     checkpoint_every=cfg.checkpoint_every,
                     return_candidates=return_neighbors, return_stats=True)

@@ -119,6 +119,34 @@ def shard_axis_size(mesh: Mesh) -> int:
     return mesh.shape[AXIS]
 
 
+def my_mesh_positions(mesh: Mesh) -> list[int]:
+    """Mesh positions whose devices this process hosts (ascending, so the
+    concatenated local block matches global index order).
+
+    Validates — identically on EVERY host, before any collective — that each
+    launched process owns at least one mesh position. When the requested
+    shard count is smaller than the pod's device count, ``get_mesh`` takes a
+    device prefix and can exclude every device of some process; that host
+    would then feed an empty block to ``make_array_from_process_local_data``
+    while the others block forever inside the collective — a silent
+    distributed hang. Raising the same error everywhere turns it into a
+    clean failure. Shared by the batch multi-host CLIs (cli/multihost.py)
+    and the multi-host serving engine (serve/engine.py)."""
+    mesh_devs = list(mesh.devices.ravel())
+    owners = {d.process_index for d in mesh_devs}
+    missing = sorted(set(range(jax.process_count())) - owners)
+    if missing:
+        raise RuntimeError(
+            f"mesh of {len(mesh_devs)} device(s) excludes all devices of "
+            f"process(es) {missing} of {jax.process_count()}; every launched "
+            "process must own at least one mesh position — increase --shards "
+            "(or the partition-file count) or launch fewer hosts")
+    my_pos = [i for i, d in enumerate(mesh_devs)
+              if d.process_index == jax.process_index()]
+    assert my_pos == sorted(my_pos)
+    return my_pos
+
+
 def pvary(x):
     """Mark a replicated value as device-varying along AXIS.
 

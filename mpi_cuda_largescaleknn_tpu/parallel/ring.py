@@ -37,6 +37,7 @@ checkpoint/resume between rounds).
 
 from __future__ import annotations
 
+import logging
 import math
 import os
 from functools import lru_cache, partial
@@ -169,14 +170,25 @@ def resolve_merge(merge: str, num_shards: int) -> str:
     ``tree_merge_candidates`` as the all-reduce form), so the host fetches
     one final [Q, k] result instead of R partial ones; ``host`` fetches all R
     partials and merges them in numpy. ``auto`` picks ``device`` whenever
-    the reduction is available — every power-of-two mesh — and falls back
-    to ``host`` otherwise (recursive doubling needs the blocks to tile the
-    axis). Results are bit-identical either way (same tie discipline); the
-    choice is pure data movement. An explicit ``device`` on a
-    non-power-of-two mesh raises rather than silently degrading.
+    the reduction is available — every power-of-two mesh, single- or
+    multi-host (the collectives ride the GLOBAL pod-mesh axis either way) —
+    and falls back to ``host`` with a logged warning otherwise (recursive
+    doubling needs the blocks to tile the axis), so an odd pod shape never
+    hard-fails a startup that ``auto`` was supposed to keep portable.
+    Results are bit-identical either way (same tie discipline); the choice
+    is pure data movement. An explicit ``device`` on a non-power-of-two
+    mesh still raises rather than silently degrading.
     """
     if merge == "auto":
-        return "device" if num_shards & (num_shards - 1) == 0 else "host"
+        if num_shards & (num_shards - 1) == 0:
+            return "device"
+        if num_shards > 1:
+            logging.getLogger(__name__).warning(
+                "merge='auto': mesh of %d shards is not a power of two — "
+                "falling back to the host-side merge (the device "
+                "reduce-scatter needs the row blocks to tile the axis)",
+                num_shards)
+        return "host"
     if merge == "device":
         if num_shards & (num_shards - 1):
             raise ValueError(
@@ -239,8 +251,10 @@ def device_merge_final(heap: CandidateState, num_shards: int,
       scalar comparator loop while its TopK is a tuned custom call.
     - ``tree``: the log2(R) ``ppermute`` recursive-doubling all-reduce
       (ops/candidates.py ``tree_merge_candidates``) followed by a slice —
-      every device transiently holds the FULL merged state, the building
-      block the multi-host front end's cross-host level wants.
+      every device transiently holds the FULL merged state, the all-reduce
+      form the multi-host serving level runs on the global pod-mesh axis
+      (the mesh decides whether the hops ride ICI or DCN; the program is
+      the same either way).
 
     Returns (dists, dist2, idx) of ``Q // num_shards`` rows; Q must be
     divisible by num_shards (callers pad the batch to a bucket that is).
@@ -894,8 +908,12 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
     memory) and the queries ride one coarse prune bucket, so device merge
     wins at SMALL chunks — the round-dispatch-bound regime — while the
     ring's fine-bucketed prune wins large ones. ``auto`` resolves like the
-    engine's (``resolve_merge``: device on power-of-two meshes);
-    single-host only.
+    engine's (``resolve_merge``: device on power-of-two meshes, host with
+    a logged warning otherwise). Both placements run multi-host: the chunk
+    is staged sharded (each host uploads its own rows) and the device-merge
+    program all_gathers it, so ``device_merge_final``'s reduction runs on
+    the GLOBAL pod-mesh axis and each host fetches only its 1/R slices of
+    the pod-final rows.
 
     Returns like ``ring_knn``: f32[R*Npad] shard-major distances (numpy),
     plus (dist2, idx) candidate arrays when ``return_candidates``.
@@ -906,7 +924,6 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
     engine = resolve_engine(engine)
     bucket_size = resolve_bucket_size(bucket_size, engine)
     num_shards = mesh.shape[AXIS]
-    merge_requested = merge
     merge = resolve_merge(merge, num_shards)
     _init, round_fn, final_fn, shard_init_fn, query_init_fn, _ifq, \
         query_from_q = _make_ring_fns(
@@ -922,14 +939,6 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
     # no host could hold at reference scale
     multi = jax.process_count() > 1
     if multi:
-        if merge == "device":
-            if merge_requested == "auto":
-                merge = "host"  # auto keeps the working ring path
-            else:
-                raise ValueError(
-                    "merge='device' chunked runs are single-host for now — "
-                    "the multi-host front end consumes the same reduction "
-                    "at the cross-host level (ROADMAP: multi-host serving)")
         if not isinstance(points_sharded, jax.Array):
             raise ValueError("multi-host chunked ring needs global sharded "
                              "jax.Arrays (see cli/multihost.py)")
@@ -1020,15 +1029,20 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
         # the replicated chunk traverses each device's OWN resident shard,
         # the R partial candidate states tree-reduce in-program, and each
         # device emits its 1/R slice of the final rows — same global row
-        # layout as the ring path, so drain/checkpoint logic is shared
+        # layout as the ring path, so drain/checkpoint logic is shared.
+        # The chunk is staged SHARDED (each host uploads only its own rows,
+        # exactly like the ring path) and replicated by an in-program
+        # all_gather, so the same program runs on a single host and on the
+        # global pod mesh — the reduction collectives below already ride
+        # whatever axis the mesh spans (ICI or DCN)
         qrows = num_shards * chunk_rows
         flat_update = (None if use_tiled
                        else _engine_fn(engine, query_tile, point_tile))
         tiled_update_m = _tiled_engine_fn(engine) if use_tiled else None
-        rep_sharding = NamedSharding(mesh, P())
 
         def merge_body(*args):
-            q, shard = args[-1], args[:-1]
+            q_local, shard = args[-1], args[:-1]
+            q = jax.lax.all_gather(q_local, AXIS, tiled=True)
             heap = pvary(init_candidates(qrows, k, max_radius))
             if use_tiled:
                 valid = q[:, 0] < PAD_SENTINEL / 2
@@ -1050,7 +1064,7 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
 
         merge_prog = jax.jit(jax.shard_map(
             merge_body, mesh=mesh,
-            in_specs=(spec,) * (4 if use_tiled else 2) + (P(),),
+            in_specs=(spec,) * (5 if use_tiled else 3),
             out_specs=(spec, spec, spec, spec), check_vma=check_vma))
 
     out_d = np.full((n_my, npad_local), np.inf, np.float32)
@@ -1113,9 +1127,10 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
             qi[j, :hi - lo] = ids_b[s][lo:hi]
         if merge == "device":
             # ids stay host-side: result neighbor ids come from the
-            # resident shard, and validity rides the sentinel coordinates
-            return lo, hi, jax.device_put(qp.reshape(-1, 3),
-                                          rep_sharding), None
+            # resident shard, and validity rides the sentinel coordinates;
+            # each host uploads only ITS rows — the program all_gathers
+            return lo, hi, to_global(qp.reshape(-1, 3),
+                                     num_shards * chunk_rows), None
         stationary, heap = qinit(
             to_global(qp.reshape(-1, 3), num_shards * chunk_rows),
             to_global(qi.reshape(-1), num_shards * chunk_rows))
@@ -1181,6 +1196,21 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
     while pending:
         drain_one()
 
+    def chunk_stats(tiles_total: int) -> dict:
+        # shared by the single- and multi-host returns (only the tile-count
+        # materialization differs between them)
+        if merge == "device" and use_tiled:
+            # device-merge tiles span the chunk's single query bucket
+            # (R*chunk_rows rows), not the ring's fine query buckets
+            _, s_p = choose_buckets(npad_local, bucket_size)
+            return {"pair_evals": tiles_total * num_shards * chunk_rows
+                    * s_p * point_group,
+                    "tiles": tiles_total, "flops_per_pair": 8}
+        return _ring_stats(
+            engine, tiles_total, bucket_size,
+            chunks_run * num_shards * num_shards * chunk_rows * npad_local,
+            q_rows=chunk_rows, p_rows=npad_local, point_group=point_group)
+
     if checkpoint_dir and stop_chunk == n_chunks:
         ckpt.clear(ckpt_dir)
     if multi:
@@ -1190,13 +1220,12 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
                 {s: out_hd2[j] for j, s in enumerate(my_pos)},
                 {s: out_idx[j] for j, s in enumerate(my_pos)}),)
         if return_stats:
-            tiles_total = int(np.sum([np.asarray(t).sum()
-                                      for t in tiles_parts]))
-            out += (_ring_stats(
-                engine, tiles_total, bucket_size,
-                chunks_run * num_shards * num_shards * chunk_rows
-                * npad_local, q_rows=chunk_rows, p_rows=npad_local,
-                point_group=point_group),)
+            # per-host view: only addressable shards' counts (a pod-global
+            # sum would need a collective nobody asked to pay for here)
+            out += (chunk_stats(int(np.sum([
+                np.sum([np.asarray(sh.data).sum()
+                        for sh in t.addressable_shards])
+                for t in tiles_parts]))),)
         return out if len(out) > 1 else out[0]
     dists = out_d.reshape(-1)
     out = (dists,)
@@ -1204,20 +1233,8 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
         out += (CandidateState(out_hd2.reshape(-1, k),
                                out_idx.reshape(-1, k)),)
     if return_stats:
-        tiles_total = int(np.sum([np.asarray(t).sum() for t in tiles_parts]))
-        if merge == "device" and use_tiled:
-            # device-merge tiles span the chunk's single query bucket
-            # (R*chunk_rows rows), not the ring's fine query buckets
-            _, s_p = choose_buckets(npad_local, bucket_size)
-            out += ({"pair_evals": tiles_total * num_shards * chunk_rows
-                     * s_p * point_group,
-                     "tiles": tiles_total, "flops_per_pair": 8},)
-        else:
-            out += (_ring_stats(
-                engine, tiles_total, bucket_size,
-                chunks_run * num_shards * num_shards * chunk_rows
-                * npad_local, q_rows=chunk_rows, p_rows=npad_local,
-                point_group=point_group),)
+        out += (chunk_stats(int(np.sum([np.asarray(t).sum()
+                                        for t in tiles_parts]))),)
     return out if len(out) > 1 else out[0]
 
 

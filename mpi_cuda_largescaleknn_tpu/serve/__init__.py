@@ -21,6 +21,13 @@ amortizes it across a request stream:
                  degradation from the Pallas engine to the XLA twin.
 - ``server``   — stdlib-HTTP JSON/binary endpoint: /knn, /healthz, /stats,
                  Prometheus-text /metrics.
+- ``frontend`` — pod-mesh serving: per-host slice servers (one engine per
+                 host over ONE global mesh, ``merge=device`` reduction on
+                 the global axis, strict-seq collective dispatch) + the
+                 fan-out front end that replicates each admitted batch,
+                 assembles per-host row slices, and re-exposes the same
+                 public contract with per-host health and straggler
+                 accounting.
 
 TPU-KNN (arXiv:2206.14286) reaches peak FLOP/s only with large fixed-shape
 query batches; PANDA (arXiv:1607.08220) frames distributed kNN as a

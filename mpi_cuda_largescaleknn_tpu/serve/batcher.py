@@ -72,13 +72,21 @@ class DynamicBatcher:
 
     def __init__(self, query_fn, *, max_batch: int,
                  max_delay_s: float = 0.002, timers=None,
-                 pipeline_depth: int = 1):
+                 pipeline_depth: int = 1, min_batch: int | None = None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         if pipeline_depth < 1:
             raise ValueError("pipeline_depth must be >= 1")
         self._query_fn = query_fn
         self.max_batch = int(max_batch)
+        #: stall-aware flush floor: while the device pipeline is BUSY (but
+        #: not full), a deadline flush is worth dispatching only for at
+        #: least this many rows — narrower slivers keep coalescing until
+        #: the pipe drains. The default (= max_batch) reproduces the old
+        #: batch-while-busy policy exactly (deadline flushes only on an
+        #: idle pipe); the server passes the engine's narrowest shape
+        #: bucket, which is what the padded program pays for anyway.
+        self.min_batch = int(min_batch) if min_batch else self.max_batch
         self.max_delay_s = float(max_delay_s)
         self._timers = timers
         self.pipeline_depth = int(pipeline_depth)
@@ -150,13 +158,17 @@ class DynamicBatcher:
     def _take_batch(self) -> list[_Request] | None:
         """Wait for a flushable batch; None on shutdown.
 
-        Batch-while-busy: under pipelining, the ``max_delay_s`` flush only
-        fires while NO batch is in flight. While the device is busy, an
-        early partial flush cannot start any sooner than the in-flight work
-        it would queue behind — it can only narrow the batch — so the queue
-        keeps accumulating toward a full flush until the device frees up
-        (the completion worker notifies). Keeps pipelined batches as wide
-        as serialized ones instead of racing ahead on 2ms slivers.
+        Batch-while-busy, stall-aware: a full queue (``max_batch`` rows)
+        always flushes. The ``max_delay_s`` deadline flush fires when the
+        pipe is idle, or — pipelined, with a free slot already reserved by
+        the dispatch worker — when at least ``min_batch`` rows are queued:
+        a sliver narrower than the engine's narrowest shape bucket cannot
+        start any sooner than the in-flight work it would queue behind, so
+        it keeps accumulating until the device frees up (the completion
+        worker notifies). The dispatch worker acquires its pipeline slot
+        BEFORE calling this, so while the pipe is FULL nothing is popped at
+        all and late arrivals coalesce into the stalled batch instead of
+        queueing behind it.
         """
         with self._cond:
             while True:
@@ -166,13 +178,14 @@ class DynamicBatcher:
                     oldest = self._queue[0]
                     flush_at = oldest.enqueued + self.max_delay_s
                     now = time.monotonic()
+                    busy_ok = (self._inflight_batches == 0
+                               or (self.pipelined
+                                   and self._queued_rows >= self.min_batch))
                     if (self._queued_rows >= self.max_batch
-                            or (now >= flush_at
-                                and self._inflight_batches == 0)
+                            or (now >= flush_at and busy_ok)
                             or self._shutdown):
                         break
-                    self._cond.wait(None if self._inflight_batches
-                                    else flush_at - now)
+                    self._cond.wait((flush_at - now) if busy_ok else None)
                 else:
                     self._cond.wait()
             # pop whole requests while they fit; a single over-wide request
@@ -247,25 +260,36 @@ class DynamicBatcher:
 
     # -------------------------------------------------- pipelined (depth > 1)
 
+    def _wait_for_work(self) -> bool:
+        """Park until at least one request is queued; False on shutdown
+        with an empty queue."""
+        with self._cond:
+            while not self._queue:
+                if self._shutdown:
+                    return False
+                self._cond.wait()
+            return True
+
     def _run_dispatch(self):
         """Flush loop: launch device work, hand futures to the completer.
 
-        Blocks (recording stall time) when ``pipeline_depth`` batches are
-        already between dispatch and demux — that bound is what keeps a
+        Stall-aware ordering: the pipeline slot is reserved BEFORE a batch
+        is popped. When ``pipeline_depth`` batches are already between
+        dispatch and demux the worker blocks here (recording stall time)
+        with the requests still IN the queue — so they keep coalescing
+        toward a full batch, and deadline-expired ones are failed at pop
+        time instead of going stale behind the semaphore. The old policy
+        popped first and stalled holding a batch whose width was frozen
+        (BENCH_serve.json depth-2 regression: 68 stalls / 1.57 s on the
+        smoke fixture). The bound itself is unchanged — it is what keeps a
         fast producer from piling unmerged device results without limit.
         """
         while True:
-            batch = self._take_batch()
-            if batch is None:
+            if not self._wait_for_work():
                 # FIFO sentinel: the completer drains everything already
                 # dispatched, then exits — a clean pipeline drain
                 self._inflight.put(None)
                 return
-            live = self._split_expired(batch)
-            if not live:
-                continue
-            merged = (live[0].queries if len(live) == 1 else
-                      np.concatenate([r.queries for r in live]))
             if not self._slots.acquire(blocking=False):
                 t0 = time.perf_counter()
                 self._slots.acquire()
@@ -274,6 +298,17 @@ class DynamicBatcher:
                 with self._cond:
                     self.dispatch_stalls += 1
                     self.dispatch_stall_seconds += stall
+            batch = self._take_batch()
+            if batch is None:
+                self._slots.release()
+                self._inflight.put(None)
+                return
+            live = self._split_expired(batch)
+            if not live:
+                self._slots.release()
+                continue
+            merged = (live[0].queries if len(live) == 1 else
+                      np.concatenate([r.queries for r in live]))
             with self._cond:
                 self._inflight_batches += 1
                 self._inflight_rows += len(merged)
@@ -359,6 +394,7 @@ class DynamicBatcher:
                     self.rows_served / self.batches, 2) if self.batches else 0,
                 "pipeline_depth": self.pipeline_depth,
                 "pipelined": self.pipelined,
+                "min_batch": self.min_batch,
                 "inflight_batches": self._inflight_batches,
                 "inflight_rows": self._inflight_rows,
                 "dispatch_stalls": self.dispatch_stalls,

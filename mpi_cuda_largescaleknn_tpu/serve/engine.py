@@ -37,6 +37,16 @@ slice) so the batcher can keep batch t+1's device traversal in flight while
 batch t's host merge runs — the serving-side analogue of the ring's
 communication/compute overlap.
 
+Pod mode: when the mesh spans processes (``jax.process_count() > 1``, the
+batch CLIs' ``jax.distributed`` lifecycle), the SAME engine runs on every
+host over the ONE global mesh — each host uploads only its addressable
+index slabs, stages the (front-end-replicated) batch from its own copy,
+and fetches only its 1/R row slices of the pod-final answer
+(``complete_slices``; requires ``merge="device"`` — host merge would need
+partials no process can address). The query program is byte-identical to
+the single-host one; only the axis the reduction collectives ride grows
+(serve/frontend.py).
+
 Query locality: the whole speedup of the tiled traversal is the per-bucket
 prune radius (ops/tiled.py ``_worst2``) — and a served batch of scattered
 user queries wrapped in ONE bucket widens that radius to the max over the
@@ -155,6 +165,23 @@ class ResidentKnnEngine:
         self.engine_name = resolve_engine(engine)
         self.bucket_size = resolve_bucket_size(bucket_size, self.engine_name)
         self.merge_mode = resolve_merge(merge, self.num_shards)
+        #: pod mode: the mesh spans processes — every host runs ONE engine
+        #: over the same global mesh, dispatches IDENTICAL batches in the
+        #: same order (the front end's contract), and fetches only its
+        #: addressable 1/R row slices of the pod-final answer
+        #: (``complete_slices``). Host merge would need remote partials no
+        #: process can address, so the cross-host level REQUIRES the
+        #: in-program reduction.
+        self._multi = jax.process_count() > 1
+        self.process_count = jax.process_count()
+        self.process_index = jax.process_index()
+        if self._multi and self.merge_mode != "device":
+            raise ValueError(
+                "multi-host serving requires the device-side merge (the R "
+                "partial candidate blocks live on devices this process "
+                "cannot address) — got merge="
+                f"'{self.merge_mode}' on a {self.num_shards}-shard pod "
+                "mesh; use merge='device' on a power-of-two mesh")
         if self.merge_mode == "device":
             # each device emits a 1/R slice of the merged result, so every
             # shape bucket must tile the mesh: both are powers of two, so
@@ -227,7 +254,10 @@ class ResidentKnnEngine:
     def _build_index(self, points, jax):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        from mpi_cuda_largescaleknn_tpu.parallel.mesh import AXIS
+        from mpi_cuda_largescaleknn_tpu.parallel.mesh import (
+            AXIS,
+            my_mesh_positions,
+        )
         from mpi_cuda_largescaleknn_tpu.parallel.ring import partition_sharded
 
         # index bounding box: the Morton admission sort's quantization
@@ -235,18 +265,49 @@ class ResidentKnnEngine:
         self._index_lo = points.min(axis=0) if len(points) else np.zeros(3)
         self._index_hi = points.max(axis=0) if len(points) else np.ones(3)
         bounds = slab_bounds(len(points), self.num_shards)
-        shards = [points[b:e] for b, e in bounds]
-        flat, ids, _counts, self.npad_local = pad_and_flatten(
-            shards, id_bases=[b for b, _ in bounds])
         sharding = NamedSharding(self.mesh, P(AXIS))
-        # the flat resident side serves the bruteforce engine; the bucketed
-        # one serves the tiled engines — both stay device-resident for the
-        # life of the process (the reference re-uploads per launch)
-        self._flat_pts = jax.device_put(flat, sharding)
-        self._flat_ids = jax.device_put(ids, sharding)
+        if self._multi:
+            # pod mode: every host loads the same full point set (serving
+            # indexes are small next to the heap/query traffic) but uploads
+            # only the slabs of the mesh positions its devices own — the
+            # batch CLIs' process-ownership discipline (cli/multihost.py)
+            npad = max(e - b for b, e in bounds)
+            my_pos = self._my_pos = my_mesh_positions(self.mesh)
+            local_flat, local_ids, _counts, self.npad_local = pad_and_flatten(
+                [points[bounds[s][0]:bounds[s][1]] for s in my_pos],
+                id_bases=[bounds[s][0] for s in my_pos], pad_to=npad)
+            rows = self.num_shards * npad
+            flat = jax.make_array_from_process_local_data(
+                sharding, local_flat, (rows, 3))
+            ids = jax.make_array_from_process_local_data(
+                sharding, local_ids, (rows,))
+            self._flat_pts, self._flat_ids = flat, ids
+        else:
+            self._my_pos = list(range(self.num_shards))
+            shards = [points[b:e] for b, e in bounds]
+            flat, ids, _counts, self.npad_local = pad_and_flatten(
+                shards, id_bases=[b for b, _ in bounds])
+            # the flat resident side serves the bruteforce engine; the
+            # bucketed one serves the tiled engines — both stay
+            # device-resident for the life of the process (the reference
+            # re-uploads per launch)
+            self._flat_pts = jax.device_put(flat, sharding)
+            self._flat_ids = jax.device_put(ids, sharding)
         self._buckets = partition_sharded(self._flat_pts, self._flat_ids,
                                           self.mesh, self.bucket_size)
         self._replicated = NamedSharding(self.mesh, P())
+
+    def _stage_replicated(self, q: np.ndarray):
+        """Upload a host batch replicated to every mesh device. Single
+        host: a plain ``device_put``. Pod mode: every process holds the
+        identical bytes (the front end replicated them), so each builds the
+        global array from its own copy — no cross-host transfer."""
+        import jax
+
+        if not self._multi:
+            return jax.device_put(q, self._replicated)
+        return jax.make_array_from_callback(
+            q.shape, self._replicated, lambda idx: q[idx])
 
     # ------------------------------------------------------------- compilation
 
@@ -383,7 +444,9 @@ class ResidentKnnEngine:
         num_pb = self._buckets.ids.shape[0] // self.num_shards
         per_row = (num_pb if engine_name == "pallas_tiled"
                    else tile_schedule_slots(num_pb))
-        return self.num_shards * qpad * per_row
+        # pod mode: counters are per-host — the denominator covers only the
+        # shards this process fetches counts from (_tiles_fetch)
+        return len(self._my_pos) * qpad * per_row
 
     def _get_executable(self, qpad: int):
         """AOT executable for (active engine, qpad); compiles on miss.
@@ -405,9 +468,8 @@ class ResidentKnnEngine:
             return exe
         with self.timers.phase(f"compile_q{qpad}"):
             fn = self._build_query_fn(self.engine_name, qpad, qb)
-            q0 = jax.device_put(
-                np.full((qpad, 3), PAD_SENTINEL, np.float32),
-                self._replicated)
+            q0 = self._stage_replicated(
+                np.full((qpad, 3), PAD_SENTINEL, np.float32))
             exe = fn.lower(*self._resident_args(self.engine_name),
                            q0).compile()
             self.compile_count += 1
@@ -433,12 +495,11 @@ class ResidentKnnEngine:
                 exe = self._get_executable(qpad)
                 # run once on an all-padding batch: pays any lazy backend
                 # init; the traversal early-exits (no real queries)
-                q0 = jax.device_put(
-                    np.full((qpad, 3), PAD_SENTINEL, np.float32),
-                    self._replicated)
+                q0 = self._stage_replicated(
+                    np.full((qpad, 3), PAD_SENTINEL, np.float32))
                 out = exe(*self._resident_args(self.engine_name), q0)
                 jax.block_until_ready(out)
-                self._count_tiles(int(np.asarray(out[2]).sum()),
+                self._count_tiles(self._tiles_fetch(out[2]),
                                   self._tiles_possible(self.engine_name,
                                                        qpad))
                 per_bucket[qpad] = round(time.perf_counter() - t0, 3)
@@ -454,6 +515,16 @@ class ResidentKnnEngine:
             return
         self.timers.count("tiles_executed", executed)
         self.timers.count("tiles_skipped", max(0, possible - executed))
+
+    def _tiles_fetch(self, t) -> int:
+        """Sum a program's per-shard tile counts. Pod mode: only this
+        process's addressable shards contribute (per-host counters; the
+        possible-tile denominator is scaled to match in
+        ``complete_slices``)."""
+        if self._multi:
+            return int(np.sum([np.asarray(sh.data).sum()
+                               for sh in t.addressable_shards]))
+        return int(np.asarray(t).sum())
 
     # ----------------------------------------------------------------- degrade
 
@@ -549,7 +620,7 @@ class ResidentKnnEngine:
             q = np.full((qpad, 3), PAD_SENTINEL, np.float32)
             q[:n] = staged
             t0 = time.perf_counter()
-            q_dev = jax.device_put(q, self._replicated)
+            q_dev = self._stage_replicated(q)
             fut = self._launch.submit(exe, *args, q_dev)
             possible = self._tiles_possible(engine_name, qpad)
         return _InFlightBatch(queries, n, qpad, engine_name,
@@ -579,6 +650,10 @@ class ResidentKnnEngine:
         if batch.n == 0:
             return (np.zeros(0, np.float32),
                     np.zeros((0, self.k), np.int32))
+        if self._multi:
+            raise RuntimeError(
+                "pod-mode engines emit per-host row slices — use "
+                "complete_slices (the front end assembles the full batch)")
         a, b, t = batch.fut.result()
         a = np.asarray(a)
         b = np.asarray(b)
@@ -589,7 +664,7 @@ class ResidentKnnEngine:
         # not payload
         self.timers.count("fetch_bytes", a.nbytes + b.nbytes)
         self.timers.count("result_rows", batch.n)
-        self._count_tiles(int(np.asarray(t).sum()), batch.tiles_possible)
+        self._count_tiles(self._tiles_fetch(t), batch.tiles_possible)
         if batch.merge_mode == "device":
             dists, nbrs = a, b  # final already: [qpad], [qpad, k]
         else:
@@ -608,6 +683,57 @@ class ResidentKnnEngine:
             out_n[batch.perm] = nbrs
             dists, nbrs = out_d, out_n
         return dists, nbrs
+
+    def complete_slices(self, batch: _InFlightBatch):
+        """Pod-mode ``complete``: fetch ONLY this process's addressable row
+        slices of the pod-final answer.
+
+        Under ``merge="device"`` on the global mesh, device at mesh
+        position r holds rows [r*qpad/R, (r+1)*qpad/R) of the final
+        [qpad] + [qpad, k] arrays — so each host's fetch moves 1/R of the
+        result per owned position and the POD's total fetched bytes equal
+        ONE final result, not one per host (the acceptance arithmetic of
+        ``serve_smoke.py --multihost-bench``). Returns
+        ``(rows i32[m], dists f32[m], nbrs i32[m, k])`` where ``rows`` are
+        CALLER-order row indices (the Morton admission sort already undone
+        per row via ``batch.perm``) and ``m`` counts only real (non-pad)
+        rows this host owns. The front end scatters each host's triple into
+        the full batch — bit-identical to a single-process ``complete`` of
+        the same program, ties included.
+        """
+        if batch.n == 0:
+            return (np.zeros(0, np.int32), np.zeros(0, np.float32),
+                    np.zeros((0, self.k), np.int32))
+        a, b, t = batch.fut.result()
+        self.timers.hist("engine_batch_seconds").record(
+            time.perf_counter() - batch.t0)
+        rp = batch.qpad // self.num_shards
+        rows_l, d_l, n_l = [], [], []
+        fetched = 0
+        nbrs_by_row = {int(sh.index[0].start): np.asarray(sh.data)
+                       for sh in b.addressable_shards}
+        for sh in a.addressable_shards:
+            lo = int(sh.index[0].start)
+            d = np.asarray(sh.data)
+            nb = nbrs_by_row[lo]
+            fetched += d.nbytes + nb.nbytes
+            staged = np.arange(lo, lo + rp)
+            real = staged < batch.n  # pad rows sort/stay last
+            if not np.any(real):
+                continue
+            staged = staged[real]
+            rows_l.append(batch.perm[staged] if batch.perm is not None
+                          else staged.astype(np.int32))
+            d_l.append(d[real])
+            n_l.append(nb[real])
+        self.timers.count("fetch_bytes", fetched)
+        self._count_tiles(self._tiles_fetch(t), batch.tiles_possible)
+        if not rows_l:
+            return (np.zeros(0, np.int32), np.zeros(0, np.float32),
+                    np.zeros((0, self.k), np.int32))
+        rows = np.concatenate(rows_l).astype(np.int32)
+        self.timers.count("result_rows", len(rows))
+        return rows, np.concatenate(d_l), np.concatenate(n_l)
 
     def query(self, queries: np.ndarray):
         """f32[n,3] -> (f32[n] k-th-NN distances, i32[n,k] neighbor ids).
@@ -633,6 +759,13 @@ class ResidentKnnEngine:
             "n_points": self.n_points,
             "k": self.k,
             "num_shards": self.num_shards,
+            # pod-mode surface: which slice of the global mesh this process
+            # owns (the front end sanity-checks coverage across hosts)
+            "multihost": self._multi,
+            "process_index": self.process_index,
+            "process_count": self.process_count,
+            "my_positions": list(self._my_pos),
+            "max_batch": self.max_batch,
             "bucket_size": self.bucket_size,
             "shape_buckets": list(self.shape_buckets),
             "compiled_shapes": sorted(k[2] for k in list(self._executables)),

@@ -1,0 +1,765 @@
+"""Pod-mesh serving: per-host slice servers + the fan-out front end.
+
+One more level of the replicate-traverse-merge shape (ROADMAP "multi-host
+serving"): H serving processes — one per pod host, joined into a single
+global device mesh by ``jax.distributed`` exactly like the batch CLIs
+(cli/multihost.py) — each run ONE ``ResidentKnnEngine`` over that global
+mesh with ``merge="device"``. The engine's AOT query program is unchanged
+from single-host serving: the PR-4 Morton admission + multi-bucket
+traversal rides inside it, and the PR-3 reduction
+(``parallel/ring.py device_merge_final`` / ops/candidates.py
+``tree_merge_candidates``) now simply runs on the GLOBAL pod-mesh axis, so
+the pod-final [Q, k] answer materializes sharded 1/R per device with NO
+host-side cross-host gather at all (PANDA's lesson: fold the reduction into
+the communication schedule, never gather partials to one node; EQuARX's:
+small-payload cross-device reductions belong inside the XLA program). Each
+host fetches only its addressable row slices (``engine.complete_slices``),
+so the POD's total fetched result bytes equal ONE final answer — a
+host-count factor below every-host-fetches-everything, on top of PR 3's
+R x within a host.
+
+Because the engine program is a collective, every host must dispatch
+IDENTICAL batches in the SAME order. That is the front end's contract:
+
+- ``PodFanout`` replicates each admitted batch (same bytes) to every
+  host's ``POST /shard_knn?seq=N``; the per-host ``HostSliceServer``
+  dispatches strictly in ``seq`` order (a condition variable reorders
+  late-arriving sockets), so the pod never interleaves.
+- The fan-out exposes the engine's ``dispatch``/``complete`` split, so the
+  front end's ``DynamicBatcher`` pipelines pod batches exactly like the
+  single-host server pipelines device batches (``pipeline_depth``).
+- ``FrontendServer`` speaks the same public contract as the single-host
+  server — POST /knn (JSON or binary), /healthz, /stats, /metrics — plus
+  per-host health and straggler accounting (per-batch spread between the
+  first and last host slice to land).
+
+Failure semantics: the pod is one SPMD machine. If any host fails or
+drops a sequence number, in-flight collectives cannot complete and the
+front end marks the pod broken (``/healthz`` -> 503, requests -> 500)
+rather than serving partial answers; restart the host processes together
+(docs/SERVING.md "Multi-host serving").
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from http.server import ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from mpi_cuda_largescaleknn_tpu.obs.timers import LatencyHistogram, PhaseTimers
+from mpi_cuda_largescaleknn_tpu.serve.admission import (
+    AdmissionController,
+    DeadlineExceeded,
+    OverloadError,
+)
+from mpi_cuda_largescaleknn_tpu.serve.batcher import DynamicBatcher
+from mpi_cuda_largescaleknn_tpu.serve.server import (
+    JsonHttpHandler,
+    ServingMetrics,
+    parse_knn_body,
+)
+
+# -------------------------------------------------------------- host side
+
+
+class HostSliceServer(ThreadingHTTPServer):
+    """Per-host serving process: one engine slice of the pod.
+
+    Serves the front end only (no public /knn): ``POST /shard_knn?seq=N``
+    with a raw little-endian f32 xyz body dispatches the batch on the
+    GLOBAL mesh — in strict ``seq`` order, because the underlying program
+    is a collective every host must enter identically — and answers with
+    this host's row slices of the pod-final result. /healthz, /stats and
+    /metrics mirror the single-host server's observability surface.
+    """
+
+    daemon_threads = True
+    #: how long a handler thread waits for ITS turn in the seq order
+    #: before giving up (a lost lower seq means the pod is wedged anyway)
+    seq_timeout_s = 120.0
+
+    def __init__(self, addr, engine, *, verbose: bool = False):
+        self.engine = engine
+        self.ready = False
+        self.verbose = verbose
+        self._loop_entered = False
+        self.metrics = ServingMetrics()
+        self._seq_cond = threading.Condition()
+        self.next_seq = 0
+        super().__init__(addr, _HostHandler)
+
+    def serve_forever(self, poll_interval=0.5):
+        self._loop_entered = True
+        super().serve_forever(poll_interval)
+
+    def close(self):
+        if self._loop_entered:
+            self.shutdown()
+        self.server_close()
+
+    def run_in_order(self, seq: int, queries: np.ndarray):
+        """Dispatch ``queries`` as pod batch ``seq`` and fetch this host's
+        slices. Dispatch is serialized in ascending ``seq`` (the pod-wide
+        program order); completes overlap freely — that is the engine's
+        dispatch/complete pipelining, per host."""
+        with self._seq_cond:
+            deadline = time.monotonic() + self.seq_timeout_s
+            while seq != self.next_seq:
+                if seq < self.next_seq:
+                    raise ValueError(f"seq {seq} already dispatched "
+                                     f"(next is {self.next_seq})")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"seq {seq} waited {self.seq_timeout_s:.0f}s for "
+                        f"seq {self.next_seq} to arrive — pod stream broken")
+                self._seq_cond.wait(remaining)
+            try:
+                handle = self.engine.dispatch(queries)
+            finally:
+                # advance even on a dispatch error: the same deterministic
+                # failure raises on EVERY host (same bytes, same config),
+                # so the pod stays aligned at seq+1
+                self.next_seq += 1
+                self._seq_cond.notify_all()
+        return self.engine.complete_slices(handle)
+
+
+class _HostHandler(JsonHttpHandler):
+    def do_GET(self):
+        srv: HostSliceServer = self.server
+        path = urlparse(self.path).path
+        if path == "/healthz":
+            body = {"status": "ok" if srv.ready else "warming",
+                    "role": "host-slice",
+                    "process_index": srv.engine.process_index,
+                    "next_seq": srv.next_seq}
+            self._send_json(200 if srv.ready else 503, body)
+        elif path == "/stats":
+            self._send_json(200, {"engine": srv.engine.stats(),
+                                  "next_seq": srv.next_seq,
+                                  "server": dict(srv.metrics.counters)})
+        elif path == "/metrics":
+            e = srv.engine.stats()
+            lines = []
+            for name, val in (
+                    ("knn_fetch_bytes_total", e["fetch_bytes"]),
+                    ("knn_result_rows_total", e["result_rows"]),
+                    ("knn_tiles_executed_total", e["tiles_executed"]),
+                    ("knn_tiles_skipped_total", e["tiles_skipped"])):
+                lines += [f"# TYPE {name} counter", f"{name} {val}"]
+            for name, val in (("knn_ready", int(srv.ready)),
+                              ("knn_compile_count", e["compile_count"]),
+                              ("knn_num_shards", e["num_shards"]),
+                              ("knn_host_process_index", e["process_index"]),
+                              ("knn_host_next_seq", srv.next_seq)):
+                lines += [f"# TYPE {name} gauge", f"{name} {val}"]
+            self._send(200, ("\n".join(lines) + "\n").encode(),
+                       "text/plain; version=0.0.4")
+        else:
+            self._send_json(404, {"error": f"no such path {path}"})
+
+    def do_POST(self):
+        srv: HostSliceServer = self.server
+        parsed = urlparse(self.path)
+        if parsed.path != "/shard_knn":
+            self._send_json(404, {"error": "POST /shard_knn only"})
+            return
+        srv.metrics.inc("knn_requests_total")
+        try:
+            seq = int(parse_qs(parsed.query).get("seq", ["-1"])[0])
+            length = int(self.headers.get("Content-Length", 0))
+            raw = self.rfile.read(length)
+            if seq < 0 or len(raw) % 12:
+                raise ValueError("need ?seq=N and an n*12-byte f32 xyz body")
+            q = np.frombuffer(raw, "<f4").reshape(-1, 3)
+        except ValueError as e:
+            srv.metrics.inc("knn_badrequest_total")
+            self._send_json(400, {"error": str(e)})
+            return
+        try:
+            rows, dists, nbrs = srv.run_in_order(seq, q)
+        except Exception as e:  # noqa: BLE001 - the front end retries/fails
+            srv.metrics.inc("knn_error_total")
+            self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        srv.metrics.inc("knn_rows_total", len(rows))
+        body = (np.ascontiguousarray(rows, "<i4").tobytes()
+                + np.ascontiguousarray(dists, "<f4").tobytes()
+                + np.ascontiguousarray(nbrs, "<i4").tobytes())
+        self._send(200, body, "application/octet-stream",
+                   extra=[("X-Knn-Rows", str(len(rows))),
+                          ("X-Knn-K", str(srv.engine.k))])
+
+
+# ---------------------------------------------------------- front-end side
+
+
+class PodBrokenError(RuntimeError):
+    """A host failed mid-stream: the pod's collective program order is
+    unrecoverable without restarting the host processes together."""
+
+
+class _HostEndpoint:
+    """Front-end bookkeeping for one host: address pieces + accounting."""
+
+    def __init__(self, url: str):
+        self.url = url
+        p = urlparse(url if "//" in url else "//" + url)
+        self.host = p.hostname or "127.0.0.1"
+        self.port = p.port or 80
+        self.prefix = p.path.rstrip("/")
+        self.latency = LatencyHistogram()
+        self.ok = 0
+        self.errors = 0
+        self.last_error: str | None = None
+
+
+class PodFanout:
+    """Replicate each batch to every host; assemble the per-host slices.
+
+    The ``dispatch``/``complete`` split mirrors the engine's, so the
+    front end's ``DynamicBatcher`` pipelines pod batches: ``dispatch``
+    assigns the next pod-wide sequence number and posts the batch to all
+    hosts concurrently (returning a handle of in-flight HTTP futures);
+    ``complete`` joins them, scatters each host's ``(rows, dists, nbrs)``
+    slices into the full batch, and records straggler spread (last host
+    minus first host wall-clock per batch). Row coverage is asserted —
+    a missing row means the pod's mesh ownership disagrees with the
+    front end's host list, never something to paper over.
+    """
+
+    def __init__(self, host_urls: list[str], *, k: int, max_batch: int,
+                 timeout_s: float = 120.0, timers: PhaseTimers | None = None):
+        if not host_urls:
+            raise ValueError("need at least one host URL")
+        self.endpoints = [_HostEndpoint(u) for u in host_urls]
+        self.k = int(k)
+        self.max_batch = int(max_batch)
+        self.timeout_s = float(timeout_s)
+        self.timers = timers if timers is not None else PhaseTimers()
+        self.broken: str | None = None
+        self._lock = threading.Lock()
+        self._seq = 0
+        self.batches = 0
+        self.straggler_seconds = 0.0
+        self._tls = threading.local()
+        # enough workers for `depth` batches x H hosts in flight
+        self._pool = ThreadPoolExecutor(
+            max_workers=4 * len(self.endpoints),
+            thread_name_prefix="knn-fanout")
+
+    # ------------------------------------------------------------- transport
+
+    def _conn(self, ep: _HostEndpoint) -> http.client.HTTPConnection:
+        conns = getattr(self._tls, "conns", None)
+        if conns is None:
+            conns = self._tls.conns = {}
+        c = conns.get(ep.url)
+        if c is None:
+            c = http.client.HTTPConnection(ep.host, ep.port,
+                                           timeout=self.timeout_s)
+            conns[ep.url] = c
+        return c
+
+    def _drop_conn(self, ep: _HostEndpoint):
+        c = getattr(self._tls, "conns", {}).pop(ep.url, None)
+        if c is not None:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+
+    def _post_shard(self, ep: _HostEndpoint, seq: int, body: bytes):
+        """POST one batch to one host; parse its slice triple. Returns
+        (rows, dists, nbrs, seconds)."""
+        t0 = time.perf_counter()
+        try:
+            conn = self._conn(ep)
+            conn.request("POST", f"{ep.prefix}/shard_knn?seq={seq}",
+                         body=body,
+                         headers={"Content-Type":
+                                  "application/octet-stream"})
+            resp = conn.getresponse()
+            payload = resp.read()
+            if resp.status != 200:
+                raise PodBrokenError(
+                    f"host {ep.url} answered {resp.status} for seq {seq}: "
+                    f"{payload[:300].decode(errors='replace')}")
+            m = int(resp.getheader("X-Knn-Rows", "-1"))
+            kk = int(resp.getheader("X-Knn-K", str(self.k)))
+            if m < 0 or kk != self.k or len(payload) != 4 * m * (2 + kk):
+                raise PodBrokenError(
+                    f"host {ep.url} slice malformed: rows={m} k={kk} "
+                    f"bytes={len(payload)}")
+            rows = np.frombuffer(payload, "<i4", count=m)
+            dists = np.frombuffer(payload, "<f4", count=m, offset=4 * m)
+            nbrs = np.frombuffer(payload, "<i4", count=m * kk,
+                                 offset=8 * m).reshape(m, kk)
+        except PodBrokenError:
+            self._drop_conn(ep)
+            raise
+        except Exception as e:
+            self._drop_conn(ep)
+            raise PodBrokenError(
+                f"host {ep.url} unreachable for seq {seq}: "
+                f"{type(e).__name__}: {e}") from e
+        return rows, dists, nbrs, time.perf_counter() - t0
+
+    # ---------------------------------------------------------- query_fn API
+
+    def dispatch(self, queries: np.ndarray):
+        """Fan one admitted batch out to every host (non-blocking)."""
+        if self.broken:
+            raise PodBrokenError(self.broken)
+        q = np.ascontiguousarray(np.asarray(queries, np.float32)
+                                 .reshape(-1, 3))
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+        body = q.astype("<f4").tobytes()
+        futs = [self._pool.submit(self._post_shard, ep, seq, body)
+                for ep in self.endpoints]
+        return {"seq": seq, "n": len(q), "futs": futs,
+                "t0": time.perf_counter()}
+
+    def complete(self, handle):
+        """Join every host's slice and assemble the full batch."""
+        n = handle["n"]
+        out_d = np.full(n, np.nan, np.float32)
+        out_n = np.full((n, self.k), -1, np.int32)
+        filled = np.zeros(n, bool)
+        dts = []
+        err: PodBrokenError | None = None
+        for ep, fut in zip(self.endpoints, handle["futs"]):
+            try:
+                rows, dists, nbrs, dt = fut.result()
+            except PodBrokenError as e:
+                with self._lock:
+                    ep.errors += 1
+                    ep.last_error = str(e)
+                err = err or e
+                continue
+            with self._lock:
+                ep.ok += 1
+                ep.latency.record(dt)
+            dts.append(dt)
+            out_d[rows] = dists
+            out_n[rows] = nbrs
+            filled[rows] = True
+        if err is not None:
+            # one SPMD machine: a lost host slice is not degradable
+            with self._lock:
+                self.broken = self.broken or str(err)
+            raise err
+        if not filled.all():
+            missing = int((~filled).sum())
+            raise PodBrokenError(
+                f"assembled batch seq {handle['seq']} is missing {missing} "
+                f"of {n} rows — host list does not cover the pod mesh")
+        with self._lock:
+            self.batches += 1
+            if len(dts) > 1:
+                spread = max(dts) - min(dts)
+                self.straggler_seconds += spread
+                self.timers.hist("fanout_straggler_seconds").record(spread)
+        self.timers.hist("fanout_batch_seconds").record(
+            time.perf_counter() - handle["t0"])
+        return out_d, out_n
+
+    def __call__(self, queries):
+        return self.complete(self.dispatch(queries))
+
+    # ------------------------------------------------------------------ admin
+
+    def probe_health(self, timeout_s: float = 2.0) -> dict:
+        """GET every host's /healthz; {url: {"ok": bool, ...}}."""
+        out = {}
+        for ep in self.endpoints:
+            try:
+                with urllib.request.urlopen(ep.url.rstrip("/") + "/healthz",
+                                            timeout=timeout_s) as r:
+                    out[ep.url] = {"ok": r.status == 200,
+                                   **json.loads(r.read().decode())}
+            except Exception as e:  # noqa: BLE001 - down IS the answer
+                out[ep.url] = {"ok": False,
+                               "error": f"{type(e).__name__}: {e}"}
+        return out
+
+    def scrape_host_stats(self, timeout_s: float = 5.0) -> dict:
+        out = {}
+        for ep in self.endpoints:
+            try:
+                with urllib.request.urlopen(ep.url.rstrip("/") + "/stats",
+                                            timeout=timeout_s) as r:
+                    out[ep.url] = json.loads(r.read().decode())
+            except Exception as e:  # noqa: BLE001 - stats are decoration
+                out[ep.url] = {"error": f"{type(e).__name__}: {e}"}
+        return out
+
+    def close(self) -> None:
+        """Stop the fan-out pool. Worker threads exit and their cached
+        per-host connections are closed with them (each thread's dict is
+        only reachable from its own ``threading.local`` slot)."""
+        self._pool.shutdown(wait=False)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "hosts": [ep.url for ep in self.endpoints],
+                "batches": self.batches,
+                "next_seq": self._seq,
+                "broken": self.broken,
+                "straggler_seconds_total": round(self.straggler_seconds, 6),
+                "per_host": {
+                    ep.url: {"ok": ep.ok, "errors": ep.errors,
+                             "last_error": ep.last_error,
+                             "latency": ep.latency.report()}
+                    for ep in self.endpoints},
+            }
+
+
+class FrontendServer(ThreadingHTTPServer):
+    """Public pod front end: the single-host server's exact HTTP contract
+    (POST /knn JSON + binary, /healthz, /stats, /metrics) backed by a
+    ``PodFanout`` instead of a local engine, with the same admission
+    backpressure and the same pipelined ``DynamicBatcher``."""
+
+    daemon_threads = True
+
+    def __init__(self, addr, fanout: PodFanout, *, max_delay_s=0.002,
+                 max_queue_rows=4096, default_timeout_s=5.0,
+                 pipeline_depth=2, min_batch=8, verbose=False):
+        self.fanout = fanout
+        self.admission = AdmissionController(
+            max_queue_rows=max_queue_rows,
+            default_timeout_s=default_timeout_s)
+        self.batcher = DynamicBatcher(fanout, max_batch=fanout.max_batch,
+                                      max_delay_s=max_delay_s,
+                                      timers=fanout.timers,
+                                      pipeline_depth=pipeline_depth,
+                                      min_batch=min_batch)
+        self.admission.pipeline_rows_fn = self.batcher.inflight_rows
+        self.metrics = ServingMetrics()
+        self.ready = False
+        self.verbose = verbose
+        self._loop_entered = False
+        super().__init__(addr, _FrontendHandler)
+
+    def serve_forever(self, poll_interval=0.5):
+        self._loop_entered = True
+        super().serve_forever(poll_interval)
+
+    def close(self):
+        self.batcher.shutdown()
+        self.fanout.close()
+        if self._loop_entered:
+            self.shutdown()
+        self.server_close()
+
+
+class _FrontendHandler(JsonHttpHandler):
+    # the POST /knn flow below deliberately mirrors server.py _Handler's
+    # (same status mapping, same binary/JSON responses) — the two ARE the
+    # same public contract; change them together
+    def do_GET(self):
+        srv: FrontendServer = self.server
+        path = urlparse(self.path).path
+        if path == "/healthz":
+            hosts = srv.fanout.probe_health()
+            ok = (srv.ready and srv.fanout.broken is None
+                  and all(h.get("ok") for h in hosts.values()))
+            self._send_json(200 if ok else 503, {
+                "status": "ok" if ok else "degraded",
+                "role": "pod-frontend",
+                "broken": srv.fanout.broken,
+                "hosts": hosts})
+        elif path == "/stats":
+            self._send_json(200, {
+                "fanout": srv.fanout.stats(),
+                "batcher": srv.batcher.stats(),
+                "admission": srv.admission.stats(),
+                "server": dict(srv.metrics.counters,
+                               request_latency=srv.metrics.latency.report()),
+                "hosts": srv.fanout.scrape_host_stats(),
+            })
+        elif path == "/metrics":
+            self._send(200, self._prometheus(srv).encode(),
+                       "text/plain; version=0.0.4")
+        else:
+            self._send_json(404, {"error": f"no such path {path}"})
+
+    @staticmethod
+    def _prometheus(srv: FrontendServer) -> str:
+        f, b, a = (srv.fanout.stats(), srv.batcher.stats(),
+                   srv.admission.stats())
+        lines = []
+        for name, val in srv.metrics.counters.items():
+            lines += [f"# TYPE {name} counter", f"{name} {val}"]
+        for name, val in (
+                ("knn_fanout_batches_total", f["batches"]),
+                ("knn_fanout_straggler_seconds_total",
+                 f["straggler_seconds_total"]),
+                ("knn_dispatch_stall_seconds_total",
+                 b["dispatch_stall_seconds"]),
+                ("knn_dispatch_stalls_total", b["dispatch_stalls"])):
+            lines += [f"# TYPE {name} counter", f"{name} {val}"]
+        gauges = {
+            "knn_ready": int(srv.ready),
+            "knn_pod_broken": int(f["broken"] is not None),
+            "knn_pod_hosts": len(f["hosts"]),
+            "knn_queue_rows": b["queue_rows"],
+            "knn_inflight_rows": a["inflight_rows"],
+            "knn_admission_rejected_total": a["rejected"],
+            "knn_batches_total": b["batches"],
+            "knn_pipeline_depth": b["pipeline_depth"],
+            "knn_pipeline_inflight_batches": b["inflight_batches"],
+        }
+        for name, val in gauges.items():
+            lines += [f"# TYPE {name} gauge", f"{name} {val}"]
+        # per-host health + latency percentiles (straggler hunting): one
+        # gauge line per host, labelled by endpoint
+        lines += ["# TYPE knn_host_up gauge", "# TYPE knn_host_p99_seconds "
+                  "gauge", "# TYPE knn_host_errors_total gauge"]
+        for url, h in f["per_host"].items():
+            up = int(h["errors"] == 0 or h["ok"] > 0)
+            p99 = h["latency"].get("p99")
+            lines += [f'knn_host_up{{host="{url}"}} {up}',
+                      f'knn_host_errors_total{{host="{url}"}} {h["errors"]}']
+            if p99 is not None:
+                lines += [f'knn_host_p99_seconds{{host="{url}"}} {p99}']
+        lines += srv.metrics.latency.prometheus_lines(
+            "knn_request_latency_seconds")
+        for src, prom in (("fanout_batch_seconds", "knn_fanout_batch_seconds"),
+                          ("fanout_straggler_seconds",
+                           "knn_fanout_straggler_seconds"),
+                          ("pipeline_stall_seconds",
+                           "knn_pipeline_stall_seconds")):
+            hist = srv.fanout.timers.histograms.get(src)
+            if hist is not None:
+                lines += hist.prometheus_lines(prom)
+        return "\n".join(lines) + "\n"
+
+    def do_POST(self):
+        srv: FrontendServer = self.server
+        if urlparse(self.path).path != "/knn":
+            self._send_json(404, {"error": "POST /knn only"})
+            return
+        srv.metrics.inc("knn_requests_total")
+        t0 = time.perf_counter()
+        try:
+            q, want_nbrs, timeout_s, binary = parse_knn_body(
+                self.path, self.headers, self.rfile)
+        except (ValueError, json.JSONDecodeError) as e:
+            srv.metrics.inc("knn_badrequest_total")
+            self._send_json(400, {"error": str(e)})
+            return
+        timeout_s = timeout_s or srv.admission.default_timeout_s
+        n = len(q)
+        if n > srv.fanout.max_batch:
+            srv.metrics.inc("knn_badrequest_total")
+            self._send_json(413, {
+                "error": f"batch of {n} exceeds max_batch "
+                         f"{srv.fanout.max_batch}; split the request"})
+            return
+        if n == 0:
+            if binary:
+                self._send(200, b"", "application/octet-stream")
+            else:
+                self._send_json(200, {"dists": []})
+            return
+        try:
+            with srv.admission.admitted_rows(n):
+                dists, nbrs = srv.batcher.submit(q, timeout_s=timeout_s)
+        except OverloadError as e:
+            srv.metrics.inc("knn_overload_total")
+            self._send_json(429, {"error": str(e)},
+                            extra=[("Retry-After", f"{e.retry_after_s:g}")])
+            return
+        except DeadlineExceeded as e:
+            srv.metrics.inc("knn_deadline_total")
+            self._send_json(504, {"error": str(e)})
+            return
+        except Exception as e:  # noqa: BLE001 - the service must not die
+            srv.metrics.inc("knn_error_total")
+            self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        srv.metrics.inc("knn_rows_total", n)
+        srv.metrics.latency.record(time.perf_counter() - t0)
+        if binary:
+            self._send(200, np.asarray(dists, "<f4").tobytes(),
+                       "application/octet-stream")
+        else:
+            out = {"dists": np.asarray(dists, np.float64).tolist()}
+            if want_nbrs:
+                out["neighbors"] = np.asarray(nbrs).tolist()
+            self._send_json(200, out)
+
+
+# ------------------------------------------------------------------ startup
+
+
+def wait_hosts_ready(host_urls: list[str], timeout_s: float = 600.0,
+                     poll_s: float = 1.0) -> None:
+    """Block until every host's /healthz answers 200 (engines warmed)."""
+    deadline = time.monotonic() + timeout_s
+    pending = list(host_urls)
+    while pending:
+        url = pending[0]
+        try:
+            with urllib.request.urlopen(url.rstrip("/") + "/healthz",
+                                        timeout=5.0) as r:
+                if r.status == 200:
+                    pending.pop(0)
+                    continue
+        except Exception:  # noqa: BLE001 - still warming / not bound yet
+            pass
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"host {url} not ready after {timeout_s:.0f}s")
+        time.sleep(poll_s)
+
+
+def pod_config_from_hosts(host_urls: list[str]) -> dict:
+    """Scrape every host's /stats and validate the pod is coherent: same
+    k / max_batch / shape buckets / merge=device, process_count matching
+    the host list, and mesh positions covering the whole axis. Returns
+    {"k", "max_batch", "min_batch", "num_shards", "n_points"}."""
+    stats = []
+    for url in host_urls:
+        with urllib.request.urlopen(url.rstrip("/") + "/stats",
+                                    timeout=10.0) as r:
+            stats.append(json.loads(r.read().decode())["engine"])
+    ref = stats[0]
+    covered: set[int] = set()
+    for url, e in zip(host_urls, stats):
+        # every key that feeds the AOT program's identity must agree, or
+        # the hosts would enter the pod-wide collective with different
+        # programs/operands (engine+buckets change the traversal;
+        # query_buckets/sort_queries change the staged batch bytes and
+        # the Morton permutation each host computes locally)
+        for key in ("k", "max_batch", "num_shards", "shape_buckets",
+                    "merge", "n_points", "engine", "bucket_size",
+                    "query_buckets", "sort_queries"):
+            if e.get(key) != ref.get(key):
+                raise ValueError(
+                    f"pod mismatch: host {url} has {key}={e.get(key)!r}, "
+                    f"host {host_urls[0]} has {ref.get(key)!r}")
+        if e.get("merge") != "device":
+            raise ValueError(f"host {url} serves merge={e.get('merge')!r}; "
+                             "the pod front end needs merge='device'")
+        if e.get("process_count") != len(host_urls):
+            raise ValueError(
+                f"host {url} reports process_count={e.get('process_count')} "
+                f"but the front end was given {len(host_urls)} hosts")
+        covered.update(e.get("my_positions", []))
+    if covered != set(range(ref["num_shards"])):
+        raise ValueError(
+            f"host list covers mesh positions {sorted(covered)} of "
+            f"{ref['num_shards']} — slices would be missing rows")
+    return {"k": ref["k"], "max_batch": ref["max_batch"],
+            "min_batch": ref["shape_buckets"][0],
+            "num_shards": ref["num_shards"], "n_points": ref["n_points"]}
+
+
+def build_frontend(host_urls: list[str], *, host: str = "127.0.0.1",
+                   port: int = 8080, max_delay_s: float = 0.002,
+                   pipeline_depth: int = 2, max_queue_rows: int = 4096,
+                   default_timeout_s: float = 5.0, timeout_s: float = 120.0,
+                   verbose: bool = False) -> FrontendServer:
+    """Validate the pod and construct (but do not start) a FrontendServer;
+    ``port=0`` picks a free port (``server.server_address[1]``)."""
+    cfg = pod_config_from_hosts(host_urls)
+    fanout = PodFanout(host_urls, k=cfg["k"], max_batch=cfg["max_batch"],
+                       timeout_s=timeout_s)
+    return FrontendServer((host, port), fanout, max_delay_s=max_delay_s,
+                          pipeline_depth=pipeline_depth,
+                          max_queue_rows=max_queue_rows,
+                          default_timeout_s=default_timeout_s,
+                          min_batch=cfg["min_batch"], verbose=verbose)
+
+
+FRONTEND_FLAGS = """
+  --hosts U1,U2,... per-host slice servers (required; one per pod host, in
+                    any order — mesh coverage is validated at startup)
+  --port P          HTTP port (default 8080; 0 = pick a free port)
+  --host H          bind address (default 127.0.0.1)
+  --max-delay-ms F  micro-batch flush deadline (default 2.0)
+  --pipeline-depth N  pod batches in flight between dispatch and demux
+                    (default 2)
+  --max-queue-rows N  admission cap on queued+running rows (default 4096)
+  --timeout-ms F    default per-request deadline (default 5000)
+  --wait-ready-s F  how long to wait for host warmup (default 600)
+  --verbose         log each HTTP request to stderr
+"""
+
+
+def main(argv: list[str] | None = None) -> int:
+    import sys
+
+    args = sys.argv[1:] if argv is None else argv
+    opt = {"hosts": "", "port": 8080, "host": "127.0.0.1",
+           "max_delay_ms": 2.0, "pipeline_depth": 2,
+           "max_queue_rows": 4096, "timeout_ms": 5000.0,
+           "wait_ready_s": 600.0, "verbose": False}
+    i = 0
+    try:
+        while i < len(args):
+            a = args[i]
+            if a == "--hosts":
+                i += 1; opt["hosts"] = args[i]
+            elif a == "--port":
+                i += 1; opt["port"] = int(args[i])
+            elif a == "--host":
+                i += 1; opt["host"] = args[i]
+            elif a == "--max-delay-ms":
+                i += 1; opt["max_delay_ms"] = float(args[i])
+            elif a == "--pipeline-depth":
+                i += 1; opt["pipeline_depth"] = int(args[i])
+            elif a == "--max-queue-rows":
+                i += 1; opt["max_queue_rows"] = int(args[i])
+            elif a == "--timeout-ms":
+                i += 1; opt["timeout_ms"] = float(args[i])
+            elif a == "--wait-ready-s":
+                i += 1; opt["wait_ready_s"] = float(args[i])
+            elif a == "--verbose":
+                opt["verbose"] = True
+            else:
+                raise ValueError(f"unknown cmdline arg '{a}'")
+            i += 1
+        hosts = [h for h in opt["hosts"].split(",") if h]
+        if not hosts:
+            raise ValueError("--hosts is required (comma-separated URLs)")
+    except (IndexError, ValueError) as e:
+        sys.stderr.write(f"Error: {e}\n\ntpuknn-frontend --hosts <urls> "
+                         f"[options]\n{FRONTEND_FLAGS}")
+        return 1
+
+    print(f"waiting for {len(hosts)} host(s) to warm up...")
+    wait_hosts_ready(hosts, timeout_s=opt["wait_ready_s"])
+    server = build_frontend(
+        hosts, host=opt["host"], port=opt["port"],
+        max_delay_s=opt["max_delay_ms"] / 1e3,
+        pipeline_depth=opt["pipeline_depth"],
+        max_queue_rows=opt["max_queue_rows"],
+        default_timeout_s=opt["timeout_ms"] / 1e3, verbose=opt["verbose"])
+    server.ready = True
+    h, p = server.server_address[:2]
+    print(f"pod front end on http://{h}:{p} fanning to {len(hosts)} host(s)")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        print("shutting down")
+    finally:
+        server.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

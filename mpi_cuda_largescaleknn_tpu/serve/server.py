@@ -40,6 +40,33 @@ from mpi_cuda_largescaleknn_tpu.serve.batcher import DynamicBatcher
 from mpi_cuda_largescaleknn_tpu.serve.engine import UnservableShapeError
 
 
+def parse_knn_body(path: str, headers, rfile):
+    """Parse one POST /knn request (shared with the pod front end).
+
+    -> (queries f32[n,3], want_neighbors, timeout_s, binary)."""
+    qs = parse_qs(urlparse(path).query)
+    length = int(headers.get("Content-Length", 0))
+    raw = rfile.read(length)
+    ctype = (headers.get("Content-Type") or "").split(";")[0].strip()
+    timeout_ms = float(qs.get("timeout_ms", [0])[0] or 0)
+    neighbors = qs.get("neighbors", ["0"])[0] not in ("0", "", "false")
+    if ctype == "application/octet-stream":
+        if len(raw) % 12:
+            raise ValueError("binary body must be n*12 bytes (f32 xyz)")
+        q = np.frombuffer(raw, "<f4").reshape(-1, 3)
+        return q, neighbors, timeout_ms / 1e3, True
+    obj = json.loads(raw.decode() or "{}")
+    q = np.asarray(obj.get("queries", []), np.float32)
+    if q.size == 0:
+        q = q.reshape(0, 3)
+    if q.ndim != 2 or q.shape[1] != 3:
+        raise ValueError(f"queries must be [n, 3], got {list(q.shape)}")
+    if not np.all(np.isfinite(q)):
+        raise ValueError("queries must be finite")
+    timeout_ms = float(obj.get("timeout_ms", timeout_ms) or 0)
+    return q, bool(obj.get("neighbors", neighbors)), timeout_ms / 1e3, False
+
+
 class ServingMetrics:
     def __init__(self):
         self._lock = threading.Lock()
@@ -72,7 +99,12 @@ class KnnServer(ThreadingHTTPServer):
                                       max_batch=engine.max_batch,
                                       max_delay_s=max_delay_s,
                                       timers=engine.timers,
-                                      pipeline_depth=pipeline_depth)
+                                      pipeline_depth=pipeline_depth,
+                                      # stall-aware flush floor: slivers
+                                      # below the narrowest shape bucket
+                                      # keep coalescing while the pipe is
+                                      # busy (serve/batcher.py)
+                                      min_batch=engine.shape_buckets[0])
         self.admission.pipeline_rows_fn = self.batcher.inflight_rows
         if self.batcher.pipelined and hasattr(engine, "set_launch_workers"):
             # let the engine keep as many programs in flight as the
@@ -98,12 +130,15 @@ class KnnServer(ThreadingHTTPServer):
         self.server_close()
 
 
-class _Handler(BaseHTTPRequestHandler):
+class JsonHttpHandler(BaseHTTPRequestHandler):
+    """Shared handler plumbing (keep-alive, quiet logging, body helpers)
+    for every serving endpoint — this server, the pod front end, and the
+    per-host slice servers (serve/frontend.py)."""
+
     protocol_version = "HTTP/1.1"
 
-    # ------------------------------------------------------------------ plumbing
     def log_message(self, fmt, *args):
-        if self.server.verbose:
+        if getattr(self.server, "verbose", False):
             super().log_message(fmt, *args)
 
     def _send(self, code: int, body: bytes, ctype: str, extra=()):
@@ -117,6 +152,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _send_json(self, code: int, obj, extra=()):
         self._send(code, json.dumps(obj).encode(), "application/json", extra)
+
+
+class _Handler(JsonHttpHandler):
 
     # ------------------------------------------------------------------ GET
     def do_GET(self):
@@ -158,7 +196,15 @@ class _Handler(BaseHTTPRequestHandler):
         for name, val in (("knn_fetch_bytes_total", e["fetch_bytes"]),
                           ("knn_result_rows_total", e["result_rows"]),
                           ("knn_tiles_executed_total", e["tiles_executed"]),
-                          ("knn_tiles_skipped_total", e["tiles_skipped"])):
+                          ("knn_tiles_skipped_total", e["tiles_skipped"]),
+                          # cumulative seconds the dispatch worker spent
+                          # blocked on the pipeline-depth bound (a proper
+                          # counter — the gauge twins below predate it and
+                          # stay for dashboard compat)
+                          ("knn_dispatch_stall_seconds_total",
+                           b["dispatch_stall_seconds"]),
+                          ("knn_dispatch_stalls_total",
+                           b["dispatch_stalls"])):
             lines += [f"# TYPE {name} counter", f"{name} {val}"]
         lines += ["# TYPE knn_merge_mode gauge",
                   f'knn_merge_mode{{mode="{e["merge"]}"}} 1']
@@ -202,27 +248,7 @@ class _Handler(BaseHTTPRequestHandler):
     # ------------------------------------------------------------------ POST
     def _parse_body(self):
         """-> (queries f32[n,3], want_neighbors, timeout_s, binary)."""
-        qs = parse_qs(urlparse(self.path).query)
-        length = int(self.headers.get("Content-Length", 0))
-        raw = self.rfile.read(length)
-        ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
-        timeout_ms = float(qs.get("timeout_ms", [0])[0] or 0)
-        neighbors = qs.get("neighbors", ["0"])[0] not in ("0", "", "false")
-        if ctype == "application/octet-stream":
-            if len(raw) % 12:
-                raise ValueError("binary body must be n*12 bytes (f32 xyz)")
-            q = np.frombuffer(raw, "<f4").reshape(-1, 3)
-            return q, neighbors, timeout_ms / 1e3, True
-        obj = json.loads(raw.decode() or "{}")
-        q = np.asarray(obj.get("queries", []), np.float32)
-        if q.size == 0:
-            q = q.reshape(0, 3)
-        if q.ndim != 2 or q.shape[1] != 3:
-            raise ValueError(f"queries must be [n, 3], got {list(q.shape)}")
-        if not np.all(np.isfinite(q)):
-            raise ValueError("queries must be finite")
-        timeout_ms = float(obj.get("timeout_ms", timeout_ms) or 0)
-        return q, bool(obj.get("neighbors", neighbors)), timeout_ms / 1e3, False
+        return parse_knn_body(self.path, self.headers, self.rfile)
 
     def do_POST(self):
         srv: KnnServer = self.server

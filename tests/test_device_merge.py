@@ -145,18 +145,35 @@ class TestResolveMerge:
         d, _ = eng.query(random_points(6, seed=2))
         assert d.shape == (6,)
 
-    def test_chunked_auto_on_multi_host_falls_back(self, monkeypatch):
-        """merge='auto' under multi-host takes the ring path instead of
-        crashing on the single-host guard (explicit device still raises
-        — covered in TestChunkedDeviceMerge)."""
+    def test_auto_non_pow2_falls_back_with_logged_warning(self, caplog):
+        """Satellite: ``auto`` on a non-power-of-two (pod) mesh falls back
+        to the host merge with a LOGGED warning — never a hard startup
+        failure — while explicit ``device`` still raises."""
+        import logging
+
+        from mpi_cuda_largescaleknn_tpu.parallel.ring import resolve_merge
+
+        with caplog.at_level(logging.WARNING,
+                             logger="mpi_cuda_largescaleknn_tpu"):
+            assert resolve_merge("auto", 6) == "host"
+        assert any("not a power of two" in r.message for r in caplog.records)
+        # R=1 "falls back" trivially to device and must not warn
+        caplog.clear()
+        with caplog.at_level(logging.WARNING,
+                             logger="mpi_cuda_largescaleknn_tpu"):
+            assert resolve_merge("auto", 1) == "device"
+        assert not caplog.records
+
+    def test_chunked_auto_on_multi_host_keeps_device(self, monkeypatch):
+        """merge='auto' under multi-host now resolves to the device merge
+        on a power-of-two global mesh (the raise was lifted) and falls
+        through to the multi-host INPUT validation, not a merge error."""
         import jax
 
         from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
         from mpi_cuda_largescaleknn_tpu.parallel.ring import ring_knn_chunked
 
         monkeypatch.setattr(jax, "process_count", lambda: 2)
-        # falls through to the multi-host input validation (host path),
-        # not the merge='device' single-host error
         with pytest.raises(ValueError, match="global sharded"):
             ring_knn_chunked(np.zeros((64, 3), np.float32),
                              np.zeros(64, np.int32), K, get_mesh(8),
@@ -239,6 +256,111 @@ class TestTreeMergeKernel:
         want_d, want_idx = _merge_shard_candidates(
             d2.copy(), idx.copy(), r, q, k)
 
+        mesh = get_mesh(r)
+        spec = P(AXIS)
+
+        def body(d2_l, idx_l):
+            dd, _d2m, ii = device_merge_final(
+                CandidateState(d2_l, idx_l), r, via=via)
+            return dd, ii
+
+        got_d, got_idx = jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=(spec, spec), out_specs=(spec, spec)))(
+            jax.device_put(d2, NamedSharding(mesh, spec)),
+            jax.device_put(idx, NamedSharding(mesh, spec)))
+        np.testing.assert_array_equal(np.asarray(got_d), want_d)
+        np.testing.assert_array_equal(np.asarray(got_idx), want_idx)
+
+
+class TestGlobalAxisTreeMerge:
+    """The pod level consumes tree_merge_candidates / device_merge_final
+    UNCHANGED on the global mesh axis (ROADMAP multi-host serving): these
+    cases stand a shard block in for each host — duplicate points spanning
+    "hosts" force cross-host equal-distance ties with different global
+    ids, and a max_radius cutoff leaves ragged rows whose untouched
+    (r^2, -1) pad slots must tie-break exactly like the single-host
+    canonical order (_merge_shard_candidates)."""
+
+    @staticmethod
+    def _shard_states(r, q, k, seed, radius=None):
+        """Real per-"host" candidate rows from a duplicate-heavy point set:
+        host s owns slab s of a point set where every point appears 4x
+        across slabs; per-host rows are the canonical (dist2, id)
+        ascending top-k of that host's slab, radius-bounded so ragged rows
+        keep their (radius^2, -1) init slots."""
+        rng = np.random.default_rng(seed)
+        base = rng.random((16, 3)).astype(np.float32)
+        pts = np.tile(base, (4, 1))  # every point duplicated across slabs
+        ids = np.arange(len(pts), dtype=np.int32)
+        queries = pts[rng.integers(0, len(pts), q)]  # queries ON dup points
+        d2 = ((queries[:, None, :].astype(np.float32)
+               - pts[None]) ** 2).sum(-1).astype(np.float32)
+        cut = (np.float32(radius) ** 2 if radius is not None
+               else np.float32(np.inf))
+        out_d2 = np.full((r * q, k), cut, np.float32)
+        out_idx = np.full((r * q, k), -1, np.int32)
+        for s, cols in enumerate(np.array_split(np.arange(len(pts)), r)):
+            dd, ii = d2[:, cols], ids[cols]
+            order = np.argsort(dd, axis=1, kind="stable")[:, :k]
+            vals = np.take_along_axis(dd, order, axis=1)
+            keep = vals < cut  # strict <, ascending rows: a prefix mask
+            out_d2[s * q:(s + 1) * q] = np.where(keep, vals, cut)
+            out_idx[s * q:(s + 1) * q] = np.where(keep, ii[order], -1)
+        return out_d2, out_idx
+
+    @pytest.mark.parametrize("r", [2, 4])
+    @pytest.mark.parametrize("radius", [None, 0.25])
+    def test_tree_all_reduce_matches_canonical_order(self, r, radius):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from mpi_cuda_largescaleknn_tpu.core.types import CandidateState
+        from mpi_cuda_largescaleknn_tpu.ops.candidates import (
+            tree_merge_candidates,
+        )
+        from mpi_cuda_largescaleknn_tpu.parallel.mesh import AXIS, get_mesh
+
+        q, k = 24, K
+        d2, idx = self._shard_states(r, q, k, seed=40 + r, radius=radius)
+        want_d, want_idx = _merge_shard_candidates(
+            d2.copy(), idx.copy(), r, q, k)
+        mesh = get_mesh(r)
+        spec = P(AXIS)
+
+        def body(d2_l, idx_l):
+            st = tree_merge_candidates(CandidateState(d2_l, idx_l), AXIS, r)
+            return st.dist2, st.idx
+
+        got_d2, got_idx = jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=(spec, spec), out_specs=(spec, spec)))(
+            jax.device_put(d2, NamedSharding(mesh, spec)),
+            jax.device_put(idx, NamedSharding(mesh, spec)))
+        got_d2 = np.asarray(got_d2).reshape(r, q, k)
+        got_idx = np.asarray(got_idx).reshape(r, q, k)
+        for host in range(r):  # all-reduce: every "host" holds the answer
+            np.testing.assert_array_equal(
+                np.sqrt(got_d2[host][:, k - 1]), want_d)
+            np.testing.assert_array_equal(got_idx[host], want_idx)
+
+    @pytest.mark.parametrize("r", [2, 4])
+    @pytest.mark.parametrize("via", ["a2a", "tree"])
+    def test_final_slices_match_canonical_order(self, r, via):
+        """device_merge_final on the same "pod" axis: each host's 1/R row
+        slice of the final answer — the bytes the serving front end
+        assembles — equals the canonical merge, ties and pads included."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from mpi_cuda_largescaleknn_tpu.core.types import CandidateState
+        from mpi_cuda_largescaleknn_tpu.parallel.mesh import AXIS, get_mesh
+        from mpi_cuda_largescaleknn_tpu.parallel.ring import (
+            device_merge_final,
+        )
+
+        q, k = 16, K
+        d2, idx = self._shard_states(r, q, k, seed=60 + r, radius=0.3)
+        want_d, want_idx = _merge_shard_candidates(
+            d2.copy(), idx.copy(), r, q, k)
         mesh = get_mesh(r)
         spec = P(AXIS)
 
@@ -372,14 +494,19 @@ class TestChunkedDeviceMerge:
                                 engine="tiled", bucket_size=32)
         np.testing.assert_array_equal(got, want)
 
-    def test_multi_host_rejected(self, monkeypatch):
+    def test_multi_host_device_merge_validates_inputs(self, monkeypatch):
+        """merge='device' is no longer rejected multi-host (the pod-mesh
+        lift); like every multi-host chunked run it requires global
+        sharded jax.Arrays. The real 2-process byte-identity proof is
+        tests/test_multihost.py
+        test_two_process_chunked_device_merge_matches_single."""
         import jax
 
         from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
         from mpi_cuda_largescaleknn_tpu.parallel.ring import ring_knn_chunked
 
         monkeypatch.setattr(jax, "process_count", lambda: 2)
-        with pytest.raises(ValueError, match="single-host"):
+        with pytest.raises(ValueError, match="global sharded"):
             ring_knn_chunked(np.zeros((64, 3), np.float32),
                              np.zeros(64, np.int32), K, get_mesh(8),
                              chunk_rows=8, merge="device")

@@ -163,6 +163,54 @@ def test_multihost_presize_clears_stale_bytes(tmp_path):
     assert np.all(np.isfinite(got)) and not np.any(got == 7.0)
 
 
+def test_two_process_chunked_device_merge_matches_single(tmp_path):
+    """The lifted multi-host ``merge=device`` chunked path: the chunk is
+    staged sharded (each host uploads its own rows), the program
+    all_gathers it, and ``device_merge_final`` reduces on the GLOBAL
+    2-process mesh — byte-identical to the single-process run of the same
+    config (which runs the literally identical SPMD program)."""
+    rng = np.random.default_rng(29)
+    n, k = 600, 5
+    pts = rng.random((n, 3)).astype(np.float32)
+    # duplicates force cross-host equal-distance ties through the
+    # global-axis reduction
+    pts[n // 2:] = pts[: n - n // 2]
+    in_path = str(tmp_path / "pts.float3")
+    pts.tofile(in_path)
+    chunk = ["--query-chunk", "100", "--bucket-size", "64",
+             "--merge", "device"]
+
+    single_out = str(tmp_path / "single.float")
+    r = subprocess.run(
+        [sys.executable, "-m",
+         "mpi_cuda_largescaleknn_tpu.cli.unordered_main",
+         in_path, "-o", single_out, "-k", str(k), "--shards", "2"] + chunk,
+        env=_cpu_env(2), capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+
+    multi_out = str(tmp_path / "multi.float")
+    port = _free_port()
+    base = [sys.executable, "-m",
+            "mpi_cuda_largescaleknn_tpu.cli.unordered_main",
+            in_path, "-o", multi_out, "-k", str(k),
+            "--coordinator", f"127.0.0.1:{port}", "--num-hosts", "2"] + chunk
+    p1 = subprocess.Popen(base + ["--host-id", "1"], env=_cpu_env(),
+                          stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                          text=True)
+    p0 = subprocess.Popen(base + ["--host-id", "0"], env=_cpu_env(),
+                          stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                          text=True)
+    _, err0 = p0.communicate(timeout=600)
+    _, err1 = p1.communicate(timeout=600)
+    assert p0.returncode == 0, err0[-2000:]
+    assert p1.returncode == 0, err1[-2000:]
+
+    want = np.fromfile(single_out, np.float32)
+    got = np.fromfile(multi_out, np.float32)
+    assert want.shape == got.shape == (n,)
+    np.testing.assert_array_equal(got, want)
+
+
 def test_two_process_query_chunk_matches_single(tmp_path):
     """--query-chunk (and --checkpoint-dir) composed with multi-host: two
     processes, >=3 chunks per shard, byte-identical to the single-process

@@ -1,0 +1,239 @@
+"""Multi-host serving: 2 pod processes + front end == 1 process, byte-for-byte.
+
+The serving analogue of tests/test_multihost.py: two serve_main processes,
+one CPU device each, joined into a single 2-device global mesh
+(jax.distributed + gloo) with ``merge="device"`` — each emits its 1/R row
+slices of the pod-final answer over POST /shard_knn — fronted by the
+in-process ``FrontendServer``/``PodFanout``. Every served byte (distances
+AND neighbor ids, ties included) must equal a single-process
+ResidentKnnEngine over a same-size mesh with the same configuration: the
+pod runs the SAME SPMD program, just spread over processes, with the PR-4
+Morton/multi-bucket pipeline riding unchanged inside each host's program.
+
+Duplicate-heavy query/point sets force cross-host equal-distance ties, so
+any tie-discipline divergence at the pod level shows up as an id mismatch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+K = 5
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _cpu_env() -> dict:
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count"))
+    return env
+
+
+def _dup_points(n, seed):
+    from tests.oracle import random_points
+
+    base = random_points(max(n // 4, 8), seed=seed)
+    reps = -(-n // len(base))
+    return np.tile(base, (reps, 1))[:n].copy()
+
+
+@pytest.fixture(scope="module")
+def pod(tmp_path_factory):
+    """Two serve_main pod processes over one 2-device mesh + their URLs."""
+    tmp = tmp_path_factory.mktemp("pod")
+    points = _dup_points(600, seed=23)
+    in_path = str(tmp / "pts.float3")
+    points.tofile(in_path)
+
+    coord = _free_port()
+    ports = [_free_port(), _free_port()]
+    base = [sys.executable, "-m",
+            "mpi_cuda_largescaleknn_tpu.cli.serve_main",
+            in_path, "-k", str(K), "--engine", "tiled",
+            "--bucket-size", "64", "--max-batch", "32", "--min-batch", "16",
+            "--merge", "device",
+            "--coordinator", f"127.0.0.1:{coord}", "--num-hosts", "2"]
+    procs = [subprocess.Popen(
+        base + ["--host-id", str(i), "--port", str(ports[i])],
+        env=_cpu_env(), stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True) for i in (1, 0)]
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    try:
+        from mpi_cuda_largescaleknn_tpu.serve.frontend import wait_hosts_ready
+
+        try:
+            wait_hosts_ready(urls, timeout_s=300.0)
+        except TimeoutError:
+            outs = [p.communicate(timeout=5) if p.poll() is not None
+                    else ("", "<still running>") for p in procs]
+            raise AssertionError(f"pod never came up: {outs}")
+        yield urls, points
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+@pytest.fixture(scope="module")
+def reference_engine():
+    """Single-process twin of the pod: same mesh size, same config."""
+    from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+    from mpi_cuda_largescaleknn_tpu.serve.engine import ResidentKnnEngine
+
+    points = _dup_points(600, seed=23)
+    eng = ResidentKnnEngine(points, K, mesh=get_mesh(2), engine="tiled",
+                            bucket_size=64, max_batch=32, min_batch=16,
+                            merge="device")
+    eng.warmup()
+    return eng
+
+
+@pytest.fixture(scope="module")
+def frontend(pod):
+    from mpi_cuda_largescaleknn_tpu.serve.frontend import build_frontend
+
+    urls, _points = pod
+    srv = build_frontend(urls, port=0, pipeline_depth=2)
+    srv.ready = True
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield srv
+    srv.close()
+
+
+def _post_knn(url, q, timeout=120):
+    req = urllib.request.Request(
+        url + "/knn",
+        data=json.dumps({"queries": q.tolist(),
+                         "neighbors": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+class TestPodServedByteIdentical:
+    def test_ragged_batches_match_single_process(self, frontend, pod,
+                                                 reference_engine):
+        """The acceptance bar: every served batch — ragged sizes padding
+        to both shape buckets, queries ON duplicated points for
+        distance-0 cross-host ties — is byte-identical to the
+        single-process engine at merge=device."""
+        _urls, points = pod
+        base = f"http://127.0.0.1:{frontend.server_address[1]}"
+        from tests.oracle import kth_nn_dist, random_points
+
+        for n in (1, 5, 16, 17, 32):
+            q = random_points(n, seed=300 + n)
+            q[: n // 2] = points[: n // 2]
+            resp = _post_knn(base, q)
+            want_d, want_n = reference_engine.query(q)
+            got_d = np.asarray(resp["dists"], np.float32)
+            got_n = np.asarray(resp["neighbors"], np.int32)
+            np.testing.assert_array_equal(got_d, want_d)
+            np.testing.assert_array_equal(got_n, want_n)
+            # and both are the true k-NN against numpy
+            np.testing.assert_allclose(got_d, kth_nn_dist(q, points, K),
+                                       rtol=5e-7, atol=1e-37)
+
+    def test_concurrent_clients_through_pipelined_fanout(self, frontend,
+                                                         reference_engine):
+        """Concurrent requests coalesce into pod batches under pipeline
+        depth 2; demuxed per-request answers still match the reference."""
+        from tests.oracle import random_points
+
+        base = f"http://127.0.0.1:{frontend.server_address[1]}"
+        results = {}
+
+        def client(i):
+            q = random_points(3 + 2 * i, seed=600 + i)
+            results[i] = (q, _post_knn(base, q))
+
+        ths = [threading.Thread(target=client, args=(i,)) for i in range(6)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        assert len(results) == 6
+        for q, resp in results.values():
+            want_d, want_n = reference_engine.query(q)
+            np.testing.assert_array_equal(
+                np.asarray(resp["dists"], np.float32), want_d)
+            np.testing.assert_array_equal(
+                np.asarray(resp["neighbors"], np.int32), want_n)
+
+    def test_health_stats_and_straggler_metrics(self, frontend, pod):
+        """/healthz aggregates per-host health; /stats and /metrics carry
+        the fan-out's per-host latency + straggler accounting and the
+        stall-aware batcher's dispatch-stall counter."""
+        urls, _ = pod
+        base = f"http://127.0.0.1:{frontend.server_address[1]}"
+        with urllib.request.urlopen(base + "/healthz", timeout=30) as r:
+            health = json.loads(r.read())
+        assert r.status == 200 and health["status"] == "ok"
+        assert set(health["hosts"]) == set(urls)
+        assert all(h["ok"] for h in health["hosts"].values())
+
+        with urllib.request.urlopen(base + "/stats", timeout=30) as r:
+            stats = json.loads(r.read())
+        fan = stats["fanout"]
+        assert fan["batches"] > 0 and fan["broken"] is None
+        assert set(fan["per_host"]) == set(urls)
+        for h in fan["per_host"].values():
+            assert h["ok"] > 0 and h["errors"] == 0
+        # each host fetched only its slices: pod fetch bytes ≈ one final
+        # result, and every host's engine reports multihost mode
+        for url in urls:
+            e = stats["hosts"][url]["engine"]
+            assert e["multihost"] is True and e["merge"] == "device"
+            assert e["fetch_bytes"] > 0
+
+        m = urllib.request.urlopen(base + "/metrics",
+                                   timeout=30).read().decode()
+        assert "knn_fanout_straggler_seconds_total" in m
+        assert "knn_dispatch_stall_seconds_total" in m
+        for url in urls:
+            assert f'knn_host_up{{host="{url}"}} 1' in m
+
+    def test_pod_fetch_bytes_are_one_result_per_batch(self, frontend, pod):
+        """The headline claim: summed across hosts, fetched result bytes
+        per padded batch equal ONE [qpad] + [qpad, k] result — an
+        every-host-fetches-everything design would pay hosts x that."""
+        urls, _ = pod
+        from tests.oracle import random_points
+
+        base = f"http://127.0.0.1:{frontend.server_address[1]}"
+
+        def pod_fetch_bytes():
+            total = 0
+            for url in urls:
+                with urllib.request.urlopen(url + "/stats", timeout=30) as r:
+                    total += json.loads(r.read())["engine"]["fetch_bytes"]
+            return total
+
+        before = pod_fetch_bytes()
+        _post_knn(base, random_points(16, seed=9))  # pads to qpad=16
+        after = pod_fetch_bytes()
+        qpad = 16
+        assert after - before == qpad * 4 + qpad * K * 4

@@ -412,6 +412,123 @@ class TestPipelinedBatcher:
         np.testing.assert_array_equal(d, q[:, 0])
         assert fake.engine_name == "tiled" and g.failures == 1
 
+    def test_stall_aware_flush_keeps_rows_queued_while_pipe_full(self):
+        """The depth-2 regression fix (BENCH_serve.json: 68 stalls/1.57s):
+        the dispatch worker reserves its pipeline slot BEFORE popping, so
+        while the pipe is FULL queued requests stay in the queue —
+        coalescable and deadline-cancellable — instead of being popped and
+        held frozen behind the semaphore. Then everything drains exactly."""
+
+        class GatedEcho:
+            def __init__(self):
+                self.release = threading.Semaphore(0)
+
+            def dispatch(self, q):
+                return q
+
+            def complete(self, q):
+                self.release.acquire()
+                return q[:, 0].copy(), np.zeros((len(q), 1), np.int32)
+
+        eng = GatedEcho()
+        b = DynamicBatcher(eng, max_batch=8, max_delay_s=0.001,
+                           pipeline_depth=2, min_batch=8)
+        try:
+            qs = [random_points(8, seed=800 + i) for i in range(4)]
+            for i, q in enumerate(qs):
+                q[:, 0] = i
+            results = [None] * 4
+            ths = [threading.Thread(
+                target=lambda i=i: results.__setitem__(
+                    i, b.submit(qs[i], timeout_s=30))) for i in range(4)]
+            for t in ths:
+                t.start()
+            # two full batches dispatch and fill the pipe...
+            deadline = time.monotonic() + 5
+            while (b.inflight_batches() < 2
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            assert b.inflight_batches() == 2
+            time.sleep(0.1)  # give a buggy dispatcher time to over-pop
+            st = b.stats()
+            # ...and the other two 8-row (>= max_batch) flushes WAIT in the
+            # queue rather than being popped into the stalled worker
+            assert st["batches"] == 2, st
+            assert b.queue_depth_rows() == 16
+            for _ in range(4):
+                eng.release.release()
+            for t in ths:
+                t.join(timeout=30)
+            st = b.stats()
+            assert st["dispatch_stalls"] >= 1
+            assert st["dispatch_stall_seconds"] > 0
+            for i, got in enumerate(results):
+                assert got is not None
+                np.testing.assert_array_equal(
+                    got[0], np.full(8, i, np.float32))
+        finally:
+            eng.release.release()
+            b.shutdown(wait=False)
+
+    def test_busy_deadline_flush_at_min_batch(self):
+        """Stall-aware flush floor: with a free pipeline slot, a deadline
+        flush of >= min_batch rows dispatches while an earlier batch is
+        still in flight (the old policy waited for a fully idle pipe);
+        slivers below min_batch keep waiting."""
+
+        class GatedEcho:
+            def __init__(self):
+                self.release = threading.Semaphore(0)
+
+            def dispatch(self, q):
+                return q
+
+            def complete(self, q):
+                self.release.acquire()
+                return q[:, 0].copy(), np.zeros((len(q), 1), np.int32)
+
+        eng = GatedEcho()
+        b = DynamicBatcher(eng, max_batch=32, max_delay_s=0.001,
+                           pipeline_depth=2, min_batch=8)
+        try:
+            out = {}
+            ths = []
+
+            def submit(tag, q):
+                t = threading.Thread(
+                    target=lambda: out.__setitem__(
+                        tag, b.submit(q, timeout_s=30)))
+                t.start()
+                ths.append(t)
+
+            submit("a", random_points(4, seed=900))  # idle pipe: flushes
+            deadline = time.monotonic() + 5
+            while b.inflight_batches() < 1 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert b.inflight_batches() == 1
+            # a 3-row sliver (< min_batch) must NOT flush while busy...
+            submit("tiny", random_points(3, seed=901))
+            time.sleep(0.05)
+            assert b.stats()["batches"] == 1
+            # ...but topping the queue up past min_batch flushes into the
+            # free slot without waiting for batch a's completion
+            submit("wide", random_points(10, seed=902))
+            deadline = time.monotonic() + 5
+            while b.stats()["batches"] < 2 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert b.stats()["batches"] == 2
+            assert b.inflight_batches() == 2
+            for _ in range(3):
+                eng.release.release()
+            for t in ths:
+                t.join(timeout=30)
+            assert set(out) == {"a", "tiny", "wide"}
+            for tag, n in (("a", 4), ("tiny", 3), ("wide", 10)):
+                assert len(out[tag][0]) == n
+        finally:
+            eng.release.release()
+            b.shutdown(wait=False)
+
     def test_stall_accounting_bounds_inflight(self):
         """With depth 2 and a slow completer, the dispatch worker must stall
         (bounded occupancy) and record it; occupancy never exceeds depth."""
@@ -754,6 +871,36 @@ class TestLoadgen:
         assert s is not None and s["pipeline_depth"] >= 1
         assert s["compile_count"] == 4  # binary traffic hit no new bucket
         json.dumps(rep)
+
+    def test_round_robin_hosts_mode(self, server, engine):
+        """--hosts: round-robin front-end-bypass across endpoints, with
+        per-endpoint p50/p95/p99 so fan-out overhead is measurable."""
+        import sys
+
+        sys.path.insert(0, "tools")
+        from loadgen import run_load
+
+        from mpi_cuda_largescaleknn_tpu.serve.server import build_server
+
+        srv2 = build_server(engine, port=0, max_delay_s=0.001)
+        srv2.ready = True
+        threading.Thread(target=srv2.serve_forever, daemon=True).start()
+        try:
+            urls = [_url(server), _url(srv2)]
+            rep = run_load(urls[0], hosts=urls, duration_s=1.0,
+                           concurrency=2, batch=4, seed=3)
+            assert rep["endpoint_mode"] == "round_robin"
+            assert set(rep["per_endpoint"]) == set(urls)
+            for u in urls:
+                ep = rep["per_endpoint"][u]
+                assert ep["requests"] > 0 and ep["ok"] > 0
+                assert ep["p50_ms"] > 0 and ep["p99_ms"] > 0
+            # round-robin spreads requests evenly-ish across endpoints
+            reqs = [rep["per_endpoint"][u]["requests"] for u in urls]
+            assert min(reqs) > 0.25 * max(reqs)
+            json.dumps(rep)
+        finally:
+            srv2.close()
 
     def test_binary_result_matches_oracle(self, server, index_points):
         """One keep-alive connection, two sequential binary posts — the

@@ -16,9 +16,13 @@ set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu pyt
 if [ "${SERVE_BENCH:-1}" != "0" ] && [ "$rc" -ne 124 ]; then
   # --locality-bench adds the clustered-vs-uniform query-locality section
   # (locality_compare): Morton admission + multi-bucket traversal vs the
-  # single-bucket baseline, gated on oracle-exactness like the rest
-  timeout -k 10 900 python tools/serve_smoke.py --duration 2 --trials 3 \
-      --locality-bench \
+  # single-bucket baseline, gated on oracle-exactness like the rest.
+  # --multihost-bench adds the pod-serving section (multihost_compare):
+  # 2 simulated host processes over one global mesh + the fan-out front
+  # end vs a single-process twin — deterministic fetched-bytes-per-pod
+  # ratio (~hosts x below per-host fetch), oracle-exact gated
+  timeout -k 10 1500 python tools/serve_smoke.py --duration 2 --trials 3 \
+      --locality-bench --multihost-bench \
       --out BENCH_serve.json >/dev/null || { brc=$?; [ "$rc" -eq 0 ] && rc=$brc; }
 fi
 exit $rc

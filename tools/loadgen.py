@@ -149,7 +149,8 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
              timeout_s: float = 10.0, seed: int = 0,
              scale: float = 1.0, server_stats: bool = False,
              binary: bool = False, workload: str = "uniform",
-             blobs: int = 16, blob_sigma: float = 0.02) -> dict:
+             blobs: int = 16, blob_sigma: float = 0.02,
+             hosts: list[str] | None = None) -> dict:
     """Drive the server; returns the JSON-able report (also the test API).
 
     ``qps > 0`` switches to open loop: the request schedule is fixed at
@@ -165,26 +166,42 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
     concurrent workers hit different blobs, so a coalesced server batch
     mixes a few tight clusters — the locality pattern the engine's Morton
     admission separates back out.
+
+    ``hosts`` switches to round-robin multi-endpoint mode: each worker
+    holds one persistent connection per endpoint and rotates requests
+    across them (front-end-BYPASS — point it at independent replica
+    servers, NOT at one pod's slice servers, whose /shard_knn protocol is
+    collective). The report then carries per-endpoint p50/p95/p99 next to
+    the aggregate, so pointing ``--url`` at the pod front end vs
+    ``--hosts`` at the same machines' standalone servers measures exactly
+    the fan-out's overhead.
     """
     if workload not in ("uniform", "clustered"):
         raise ValueError(f"unknown workload '{workload}'")
+    endpoints = list(hosts) if hosts else [url]
     # blob centers are seed-deterministic and shared by all workers; each
     # request picks a blob, so the stream is a mixture of tight clusters.
     # Query draws use a PER-WORKER Generator (numpy Generators are not
     # thread-safe — concurrent draws from a shared one can corrupt state)
     centers = np.random.default_rng(seed).random((max(1, blobs), 3)) * scale
     hist = LatencyHistogram()
+    ep_hists = {u: LatencyHistogram() for u in endpoints}
     lock = threading.Lock()
     counts = {"ok": 0, "overload": 0, "deadline": 0, "http_error": 0,
               "net_error": 0, "rows_ok": 0, "sched_skipped": 0}
+    ep_counts = {u: {"requests": 0, "ok": 0, "errors": 0}
+                 for u in endpoints}
     stop_at = time.monotonic() + duration_s
 
-    def account(status: int, dt: float, rows: int):
+    def account(endpoint: str, status: int, dt: float, rows: int):
         hist.record(dt)
+        ep_hists[endpoint].record(dt)
         with lock:
+            ep_counts[endpoint]["requests"] += 1
             if status == 200:
                 counts["ok"] += 1
                 counts["rows_ok"] += rows
+                ep_counts[endpoint]["ok"] += 1
             elif status == 429:
                 counts["overload"] += 1
             elif status == 504:
@@ -192,34 +209,54 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
             else:
                 counts["http_error"] += 1
 
-    def one_request(client: _Client, rng: np.random.Generator):
+    def one_request(pick_client, rng: np.random.Generator):
         if workload == "clustered":
             c = centers[rng.integers(len(centers))]
             q = np.clip(c + rng.normal(0.0, blob_sigma * scale, (batch, 3)),
                         0.0, scale).astype(np.float32)
         else:
             q = (rng.random((batch, 3)) * scale).astype(np.float32)
+        endpoint, client = pick_client()
         t0 = time.perf_counter()
         try:
             status = client.post_batch(q, neighbors, binary)
-            account(status, time.perf_counter() - t0,
+            account(endpoint, status, time.perf_counter() - t0,
                     batch if status == 200 else 0)
         except Exception:  # noqa: BLE001 - connection refused/reset, timeout
             with lock:
                 counts["net_error"] += 1
+                ep_counts[endpoint]["requests"] += 1
+                ep_counts[endpoint]["errors"] += 1
+
+    def make_picker(wid: int):
+        """One persistent connection per endpoint per worker; round-robin
+        rotation offset by worker id so concurrent workers spread load."""
+        clients = {u: _Client(u, timeout_s) for u in endpoints}
+        state = {"i": wid}
+
+        def pick():
+            u = endpoints[state["i"] % len(endpoints)]
+            state["i"] += 1
+            return u, clients[u]
+
+        def close_all():
+            for c in clients.values():
+                c.close()
+
+        return pick, close_all
 
     def closed_worker(wid: int):
-        client = _Client(url, timeout_s)
+        pick, close_all = make_picker(wid)
         wrng = np.random.default_rng((seed, wid))
         try:
             while time.monotonic() < stop_at:
-                one_request(client, wrng)
+                one_request(pick, wrng)
         finally:
-            client.close()
+            close_all()
 
     def open_worker(wid: int):
         # worker wid owns schedule slots wid, wid+W, wid+2W, ...
-        client = _Client(url, timeout_s)
+        pick, close_all = make_picker(wid)
         wrng = np.random.default_rng((seed, wid))
         interval = concurrency / qps
         next_t = time.monotonic() + (wid / qps)
@@ -236,10 +273,10 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
                     with lock:
                         counts["sched_skipped"] += missed
                     continue
-                one_request(client, wrng)
+                one_request(pick, wrng)
                 next_t += interval
         finally:
-            client.close()
+            close_all()
 
     t_start = time.monotonic()
     workers = [threading.Thread(
@@ -255,10 +292,30 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
     total = sum(counts[c] for c in
                 ("ok", "overload", "deadline", "http_error"))
     lat = hist.report()
+
+    def _pct_ms(rep, p):
+        return None if rep[p] is None else round(rep[p] * 1e3, 3)
+
+    per_endpoint = None
+    if hosts:
+        per_endpoint = {}
+        for u in endpoints:
+            rep = ep_hists[u].report()
+            per_endpoint[u] = {
+                **ep_counts[u],
+                "qps": round(ep_counts[u]["requests"] / elapsed, 2),
+                "p50_ms": _pct_ms(rep, "p50"),
+                "p95_ms": _pct_ms(rep, "p95"),
+                "p99_ms": _pct_ms(rep, "p99"),
+            }
     return {
-        **({"server": _server_pipeline_stats(url, timeout_s)}
+        **({"server": ({u: _server_pipeline_stats(u, timeout_s)
+                        for u in endpoints} if hosts
+                       else _server_pipeline_stats(url, timeout_s))}
            if server_stats else {}),
         "mode": "open" if qps > 0 else "closed",
+        **({"endpoint_mode": "round_robin",
+            "per_endpoint": per_endpoint} if hosts else {}),
         "workload": workload,
         **({"blobs": blobs, "blob_sigma": blob_sigma}
            if workload == "clustered" else {}),
@@ -280,6 +337,11 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--url", default="http://127.0.0.1:8080")
+    ap.add_argument("--hosts", default=None,
+                    help="comma-separated endpoint URLs: round-robin "
+                         "front-end-bypass mode with per-endpoint "
+                         "p50/p95/p99 (point at independent replica "
+                         "servers; for a pod, --url the front end instead)")
     ap.add_argument("--duration", type=float, default=5.0)
     ap.add_argument("--concurrency", type=int, default=4)
     ap.add_argument("--batch", type=int, default=8,
@@ -308,12 +370,13 @@ def main(argv=None) -> int:
     ap.add_argument("--out", default=None, help="write JSON report here")
     a = ap.parse_args(argv)
 
+    hosts = ([h for h in a.hosts.split(",") if h] if a.hosts else None)
     report = run_load(a.url, duration_s=a.duration, concurrency=a.concurrency,
                       batch=a.batch, qps=a.qps, neighbors=a.neighbors,
                       timeout_s=a.timeout, seed=a.seed, scale=a.scale,
                       server_stats=a.server_stats, binary=a.binary,
                       workload=a.workload, blobs=a.blobs,
-                      blob_sigma=a.blob_sigma)
+                      blob_sigma=a.blob_sigma, hosts=hosts)
     text = json.dumps(report, indent=2)
     print(text)
     if a.out:

@@ -3,8 +3,12 @@
 plus merge=host vs merge=device at depth 2, plus (``--locality-bench``) the
 query-locality comparison — clustered vs uniform workloads at
 query_buckets 1 vs auto, gated on deterministic tile-skip accounting
-(``locality_compare`` in BENCH_serve.json; tools/ci_tier1.sh passes the
-flag).
+(``locality_compare`` in BENCH_serve.json), plus (``--multihost-bench``)
+the pod-serving comparison — 2 simulated host processes over one global
+mesh + the fan-out front end vs a single-process server of the same
+config, gated on oracle-exactness with the deterministic
+fetched-bytes-per-pod ratio as the headline (``multihost_compare``;
+tools/ci_tier1.sh passes both flags).
 
 Boots the full serving stack in-process on a CPU fixture (default: one
 virtual device, single-threaded Eigen, tiled engine — one core per
@@ -374,6 +378,173 @@ def run_locality_bench(*, n_points=8192, k=16, duration_s=2.0,
     return out
 
 
+def run_multihost_bench(*, n_points=8192, k=16, hosts=2, duration_s=2.0,
+                        concurrency=8, batch=64, max_batch=128,
+                        max_delay_s=0.008, trials=2, seed=0) -> dict:
+    """Pod serving (2 simulated host processes over ONE global CPU mesh +
+    the fan-out front end) vs a single-process server of the SAME config
+    (same mesh size, merge=device, same AOT programs).
+
+    The headline number is DETERMINISTIC fetch accounting, not a timing:
+    under the pod-mesh device merge each host fetches only its addressable
+    1/R row slices, so the POD's fetched result bytes per row must equal
+    the single-process server's — i.e. ``hosts`` x fewer than the
+    every-host-fetches-the-full-result design
+    (``fetch_ratio_per_host_fetch_vs_pod`` ~ hosts). ``qps_ratio`` is
+    trajectory data on a shared box; only oracle-exactness (through the
+    full front-end fan-out/assembly path) gates the exit code.
+    """
+    _setup_cpu_fixture(hosts)  # the single-process twin runs the same R
+    from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+    from mpi_cuda_largescaleknn_tpu.serve.engine import ResidentKnnEngine
+    from mpi_cuda_largescaleknn_tpu.serve.frontend import (
+        build_frontend,
+        wait_hosts_ready,
+    )
+    from mpi_cuda_largescaleknn_tpu.serve.server import build_server
+
+    rng = np.random.default_rng(seed)
+    points = rng.random((n_points, 3)).astype(np.float32)
+
+    eng = ResidentKnnEngine(points, k, mesh=get_mesh(hosts), engine="tiled",
+                            bucket_size=64, max_batch=max_batch,
+                            min_batch=16, merge="device")
+    eng.warmup()
+
+    def loadgen_trial(base, trial):
+        exact = _probe_oracle_exact(base, points, k, seed)
+        rep = _run_loadgen(base, duration_s=duration_s,
+                           concurrency=concurrency, batch=batch,
+                           seed=seed + trial)
+        rep["oracle_exact"] = exact
+        return rep
+
+    def single_trial(trial):
+        srv = build_server(eng, port=0, max_delay_s=max_delay_s,
+                           pipeline_depth=2)
+        srv.ready = True
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            return loadgen_trial(
+                f"http://127.0.0.1:{srv.server_address[1]}", trial)
+        finally:
+            srv.close()
+
+    # --- pod: one serve_main process per host, 1 device each, one global
+    # mesh (jax.distributed over gloo) — each grandchild pins its own
+    # device count, so this process's fixture flags must not leak in
+    import socket
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+        and "xla_cpu_multi_thread_eigen" not in f).strip()
+    with tempfile.NamedTemporaryFile(suffix=".float3", delete=False) as f:
+        pts_path = f.name
+    points.tofile(pts_path)
+    coord = free_port()
+    ports = [free_port() for _ in range(hosts)]
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    base_cmd = [sys.executable, "-m",
+                "mpi_cuda_largescaleknn_tpu.cli.serve_main",
+                pts_path, "-k", str(k), "--engine", "tiled",
+                "--bucket-size", "64", "--max-batch", str(max_batch),
+                "--min-batch", "16", "--merge", "device",
+                "--coordinator", f"127.0.0.1:{coord}",
+                "--num-hosts", str(hosts)]
+    procs = [subprocess.Popen(
+        base_cmd + ["--host-id", str(i), "--port", str(ports[i])],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+        text=True) for i in range(hosts)]
+    fe = None
+    try:
+        try:
+            wait_hosts_ready(urls, timeout_s=600.0)
+        except TimeoutError as e:
+            errs = [p.communicate()[1][-500:] if p.poll() is not None
+                    else "<running>" for p in procs]
+            return {"kind": "serve_multihost_bench", "hosts": hosts,
+                    "error": f"{e} :: {errs}"}
+        fe = build_frontend(urls, port=0, max_delay_s=max_delay_s,
+                            pipeline_depth=2)
+        fe.ready = True
+        threading.Thread(target=fe.serve_forever, daemon=True).start()
+        fe_url = f"http://127.0.0.1:{fe.server_address[1]}"
+
+        pod_trial = lambda trial: loadgen_trial(fe_url, trial)  # noqa: E731
+
+        single_trial(trials)  # cold-start burn (see run_smoke)
+        pod_trial(trials)
+        runs = {"single": [], "pod": []}
+        for trial in range(trials):
+            runs["single"].append(single_trial(trial))
+            runs["pod"].append(pod_trial(trial))
+
+        def scrape_engine(url):
+            with urllib.request.urlopen(url + "/stats", timeout=30) as r:
+                return json.loads(r.read().decode())["engine"]
+
+        host_engines = [scrape_engine(u) for u in urls]
+        pod_fetch = sum(e["fetch_bytes"] for e in host_engines)
+        pod_rows = sum(e["result_rows"] for e in host_engines)
+        single_stats = eng.stats()
+
+        out = {
+            "kind": "serve_multihost_bench", "hosts": hosts,
+            "n_points": n_points, "k": k, "pipeline_depth": 2,
+            "duration_s": duration_s, "concurrency": concurrency,
+            "batch": batch, "trials": trials,
+        }
+        for key, reps in runs.items():
+            med = sorted(reps, key=lambda r: r["qps"])[len(reps) // 2]
+            out[key] = {"qps": med["qps"], "p99_ms": med["p99_ms"],
+                        "qps_trials": [r["qps"] for r in reps],
+                        "oracle_exact": all(r["oracle_exact"]
+                                            for r in reps)}
+        single_per_row = (single_stats["fetch_bytes"]
+                          / max(1, single_stats["result_rows"]))
+        pod_per_row = pod_fetch / max(1, pod_rows)
+        out["fetch_bytes_per_row_single"] = round(single_per_row, 2)
+        out["fetch_bytes_per_row_pod"] = round(pod_per_row, 2)
+        # the hosts-x claim: a per-host-fetch design pays hosts x the
+        # single-process result bytes; the pod-mesh merge pays ~1 x
+        out["fetch_ratio_per_host_fetch_vs_pod"] = round(
+            hosts * single_per_row / max(pod_per_row, 1e-9), 2)
+        out["per_host_engines"] = [
+            {"process_index": e["process_index"],
+             "my_positions": e["my_positions"],
+             "fetch_bytes": e["fetch_bytes"],
+             "result_rows": e["result_rows"],
+             "compile_count": e["compile_count"]} for e in host_engines]
+        out["oracle_exact"] = (out["single"]["oracle_exact"]
+                               and out["pod"]["oracle_exact"])
+        if out["single"]["qps"]:
+            out["qps_ratio_pod_vs_single"] = round(
+                out["pod"]["qps"] / out["single"]["qps"], 3)
+        return out
+    finally:
+        if fe is not None:
+            fe.close()
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        os.unlink(pts_path)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--points", type=int, default=8192)
@@ -407,7 +578,25 @@ def main(argv=None) -> int:
                     help="internal: run ONLY the locality bench in this "
                          "process (needs its own 1-device fixture) and "
                          "print its JSON")
+    ap.add_argument("--multihost-bench", action="store_true",
+                    help="also run the multi-host serving bench (2 pod "
+                         "processes + front end vs a single-process server "
+                         "of the same config) in a subprocess and embed "
+                         "multihost_compare")
+    ap.add_argument("--multihost-child", action="store_true",
+                    help="internal: run ONLY the multi-host bench in this "
+                         "process (needs its own 2-device fixture for the "
+                         "single-process twin) and print its JSON")
     a = ap.parse_args(argv)
+
+    if a.multihost_child:
+        report = run_multihost_bench(
+            n_points=a.points, k=a.k, duration_s=a.duration,
+            concurrency=a.concurrency, batch=a.batch,
+            trials=max(1, a.trials - 1), max_delay_s=a.max_delay_ms / 1e3,
+            seed=a.seed)
+        print(json.dumps(report, indent=2))
+        return 0 if report.get("oracle_exact") else 1
 
     if a.locality_child:
         report = run_locality_bench(
@@ -506,6 +695,36 @@ def main(argv=None) -> int:
                 detail = (raw.decode(errors="replace")
                           if isinstance(raw, bytes) else str(raw))[-1500:]
             report["locality_compare"] = {
+                "error": f"{str(e)[:300]} :: {detail}"}
+    if a.multihost_bench:
+        # same subprocess discipline: the multi-host child pins a 2-device
+        # fixture for the single-process twin and spawns the pod processes
+        # itself. The deterministic fetch-per-pod ratio is the headline;
+        # oracle-exactness (through the front-end assembly) gates the exit.
+        try:
+            child = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--multihost-child",
+                 "--points", str(a.points), "--k", str(a.k),
+                 "--duration", str(a.duration),
+                 "--concurrency", str(a.concurrency),
+                 "--batch", str(a.batch), "--trials", str(a.trials),
+                 "--max-delay-ms", str(a.max_delay_ms),
+                 "--seed", str(a.seed)],
+                capture_output=True, text=True, env=env,
+                timeout=600 + a.duration * (a.trials + 2) * 6)
+            mh = json.loads(child.stdout)
+            report["multihost_compare"] = mh
+            if "error" not in mh:  # infra hiccups degrade, never gate
+                ok = ok and bool(mh.get("oracle_exact"))
+        except (subprocess.TimeoutExpired, json.JSONDecodeError) as e:
+            if isinstance(e, json.JSONDecodeError):
+                detail = (child.stderr or child.stdout or "")[-1500:]
+            else:
+                raw = e.stderr or e.stdout or b""
+                detail = (raw.decode(errors="replace")
+                          if isinstance(raw, bytes) else str(raw))[-1500:]
+            report["multihost_compare"] = {
                 "error": f"{str(e)[:300]} :: {detail}"}
     text = json.dumps(report, indent=2)
     print(text)
