@@ -37,6 +37,11 @@ TPU-side options (no reference analogue):
                     the SPMD program on the global mesh axis and fetches
                     final rows only; auto = device on power-of-two meshes,
                     host with a logged warning otherwise)
+  --score-dtype T   distance scoring: f32 (exact elementwise, the default)
+                    | bf16 (matmul-form MXU score + exact f32 rescore of
+                    the top survivors — same final results whenever the
+                    true top-k sits inside the rescore window; see
+                    docs/TUNING.md "Distance kernel")
   --profile-dir D   write a jax.profiler trace
   --timings         print phase timings as JSON to stderr
   --checkpoint-dir D  snapshot exchange state between rounds (both
@@ -78,7 +83,7 @@ def parse_args(program: str, argv: list[str]):
               "profile_dir": None,
               "timings": False, "checkpoint_dir": None, "checkpoint_every": 1,
               "write_indices": None, "query_chunk": 0, "selfcheck": 0,
-              "merge": "host",
+              "merge": "host", "score_dtype": "f32",
               "coordinator": None, "num_hosts": 1, "host_id": 0}
     i = 0
     try:
@@ -120,6 +125,8 @@ def parse_args(program: str, argv: list[str]):
                 i += 1; extras["query_chunk"] = int(argv[i])
             elif arg == "--merge":
                 i += 1; extras["merge"] = argv[i]
+            elif arg == "--score-dtype":
+                i += 1; extras["score_dtype"] = argv[i]
             elif arg == "--selfcheck":
                 i += 1; extras["selfcheck"] = int(argv[i])
             elif arg == "--coordinator":
@@ -149,6 +156,7 @@ def parse_args(program: str, argv: list[str]):
                     num_shards=extras["shards"] or 0,
                     query_chunk=extras["query_chunk"],
                     merge=extras["merge"],
+                    score_dtype=extras["score_dtype"],
                     profile_dir=extras["profile_dir"],
                     checkpoint_dir=extras["checkpoint_dir"],
                     checkpoint_every=extras["checkpoint_every"])

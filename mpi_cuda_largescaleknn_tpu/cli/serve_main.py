@@ -38,6 +38,9 @@ SERVE_FLAGS = """
                     R partials; auto = device on power-of-two meshes
   --shards N        size of the 1-D device mesh (default: all devices)
   --bucket-size N   points per spatial bucket (0 = engine-tuned auto)
+  --score-dtype T   distance scoring: f32 (exact elementwise, the default)
+                    | bf16 (matmul-form MXU score + exact f32 rescore of
+                    the top survivors; docs/TUNING.md "Distance kernel")
   --query-buckets N query-side buckets per padded batch (0 = auto, ~k
                     queries per bucket; 1 = single whole-batch bucket AND
                     disables the Morton admission sort — the pre-locality
@@ -77,7 +80,7 @@ def usage(error: str) -> "NoReturn":  # noqa: F821
 def parse_serve_args(argv: list[str]) -> dict:
     opt = {"k": 0, "max_radius": math.inf, "in_path": "", "port": 8080,
            "host": "127.0.0.1", "engine": "auto", "merge": "auto",
-           "shards": None,
+           "score_dtype": "f32", "shards": None,
            "bucket_size": 0, "query_buckets": 0,
            "max_batch": 1024, "min_batch": 8,
            "max_delay_ms": 2.0, "pipeline_depth": 2,
@@ -103,6 +106,8 @@ def parse_serve_args(argv: list[str]) -> dict:
                 i += 1; opt["engine"] = argv[i]
             elif arg == "--merge":
                 i += 1; opt["merge"] = argv[i]
+            elif arg == "--score-dtype":
+                i += 1; opt["score_dtype"] = argv[i]
             elif arg == "--shards":
                 i += 1; opt["shards"] = int(argv[i])
             elif arg == "--bucket-size":
@@ -174,7 +179,8 @@ def main(argv: list[str] | None = None) -> int:
         engine=opt["engine"], bucket_size=opt["bucket_size"],
         max_radius=opt["max_radius"], max_batch=opt["max_batch"],
         min_batch=opt["min_batch"], merge=opt["merge"],
-        query_buckets=opt["query_buckets"])
+        query_buckets=opt["query_buckets"],
+        score_dtype=opt["score_dtype"])
 
     if opt["num_hosts"] > 1:
         from mpi_cuda_largescaleknn_tpu.serve.frontend import HostSliceServer

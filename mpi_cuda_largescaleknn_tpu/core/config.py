@@ -52,6 +52,12 @@ class KnnConfig:
                                      # reduction in-program on the global
                                      # mesh axis), "auto" (device on
                                      # power-of-two meshes)
+    score_dtype: str = "f32"         # distance scoring: "f32" = exact
+                                     # elementwise (VPU), "bf16" =
+                                     # matmul-form MXU score + exact f32
+                                     # rescore of the survivors
+                                     # (ops/distance.py, docs/TUNING.md
+                                     # "Distance kernel")
     profile_dir: str | None = None   # jax.profiler trace output
     checkpoint_dir: str | None = None  # ring-state checkpoint/resume
     checkpoint_every: int = 1        # rounds between snapshots
@@ -66,6 +72,9 @@ class KnnConfig:
         if self.merge not in ("host", "device", "auto"):
             raise ValueError(f"unknown merge mode '{self.merge}' "
                              "(expected host | device | auto)")
+        if self.score_dtype not in ("f32", "bf16"):
+            raise ValueError(f"unknown score_dtype '{self.score_dtype}' "
+                             "(expected f32 | bf16)")
         pg = self.point_group
         if pg < 0 or (pg and (pg & (pg - 1)) != 0):
             raise ValueError(

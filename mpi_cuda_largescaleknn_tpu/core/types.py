@@ -81,13 +81,13 @@ def aabb_box_distance(a_lower, a_upper, b_lower, b_upper) -> jnp.ndarray:
 
 
 def pad_points(points, padded_size: int):
-    """Pad ``f32[N,3]`` to ``f32[padded_size,3]`` with PAD_SENTINEL rows.
+    """Pad ``f32[N,D]`` to ``f32[padded_size,D]`` with PAD_SENTINEL rows.
 
     Returns (padded_points, valid_mask[padded_size]).
     """
-    n = points.shape[0]
+    n, dim = points.shape
     assert padded_size >= n, (padded_size, n)
-    pad = jnp.full((padded_size - n, 3), PAD_SENTINEL, dtype=jnp.float32)
+    pad = jnp.full((padded_size - n, dim), PAD_SENTINEL, dtype=jnp.float32)
     out = jnp.concatenate([jnp.asarray(points, jnp.float32), pad], axis=0)
     mask = jnp.arange(padded_size) < n
     return out, mask

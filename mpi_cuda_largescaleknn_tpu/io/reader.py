@@ -52,7 +52,17 @@ def read_file_portion(path: str, rank: int, size: int):
 
 def read_points(path: str) -> np.ndarray:
     """Whole-file read (the prepartitioned variant's per-rank
-    ``readFilePortion(..., 0, 1)``, prePartitionedDataVariant.cu:228-229)."""
+    ``readFilePortion(..., 0, 1)``, prePartitionedDataVariant.cu:228-229).
+
+    ``.npy`` inputs are accepted for D-generic point sets (the ``.float3``
+    raw format is inherently 3-component): any f32-coercible [N, D] array
+    serves — the matmul-form scorer is what makes high D affordable."""
+    if path.endswith(".npy"):
+        pts = np.asarray(np.load(path), np.float32)
+        if pts.ndim != 2 or pts.shape[1] < 1:
+            raise ValueError(f"{path}: expected an [N, D] array, got "
+                             f"shape {list(pts.shape)}")
+        return pts
     pts, _, _ = read_file_portion(path, 0, 1)
     return pts
 

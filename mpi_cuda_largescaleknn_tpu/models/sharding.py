@@ -30,7 +30,7 @@ def slab_bounds(num_total: int, num_shards: int) -> list[tuple[int, int]]:
 
 
 def pad_and_flatten(shards: list[np.ndarray], id_bases: list[int] | None = None,
-                    pad_to: int | None = None):
+                    pad_to: int | None = None, dim: int | None = None):
     """Pack per-shard point arrays into the engines' shard-major layout.
 
     Returns (points f32[R*Npad,3], ids i32[R*Npad], counts [R], Npad) where
@@ -50,7 +50,12 @@ def pad_and_flatten(shards: list[np.ndarray], id_bases: list[int] | None = None,
     counts = [len(s) for s in shards]
     npad = max(max(counts), 1) if pad_to is None else pad_to
     assert npad >= max(counts)
-    points = np.full((num_shards * npad, 3), PAD_SENTINEL, np.float32)
+    if dim is None:
+        # derive D from the data; callers with possibly ALL-empty shards
+        # (pod hosts owning only padding slabs) must pass dim explicitly
+        dims = {np.asarray(s).shape[-1] for s in shards if len(s)}
+        dim = dims.pop() if len(dims) == 1 else 3
+    points = np.full((num_shards * npad, dim), PAD_SENTINEL, np.float32)
     ids = np.full(num_shards * npad, -1, np.int32)
     for r, s in enumerate(shards):
         points[r * npad:r * npad + counts[r]] = np.asarray(s, np.float32)

@@ -60,7 +60,8 @@ class UnorderedKNN:
             bounds = slab_bounds(n_total, num_shards)
             shards = [points[b:e] for b, e in bounds]
             flat, ids, counts, npad = pad_and_flatten(
-                shards, id_bases=[b for b, _ in bounds])
+                shards, id_bases=[b for b, _ in bounds],
+                dim=int(np.asarray(points).shape[-1]))
 
         cands = None
         # tree bytes x rotations: the bidirectional sweep rotates two
@@ -81,6 +82,7 @@ class UnorderedKNN:
                     point_tile=cfg.point_tile, bucket_size=cfg.bucket_size,
                     point_group=cfg.point_group,
                     chunk_rows=cfg.query_chunk, merge=cfg.merge,
+                    score_dtype=cfg.score_dtype,
                     checkpoint_dir=cfg.checkpoint_dir,
                     checkpoint_every=cfg.checkpoint_every,
                     return_candidates=return_neighbors, return_stats=True)
@@ -90,6 +92,7 @@ class UnorderedKNN:
                     engine=cfg.engine, query_tile=cfg.query_tile,
                     point_tile=cfg.point_tile, bucket_size=cfg.bucket_size,
                     point_group=cfg.point_group,
+                    score_dtype=cfg.score_dtype,
                     checkpoint_dir=cfg.checkpoint_dir,
                     checkpoint_every=cfg.checkpoint_every,
                     return_candidates=return_neighbors, return_stats=True)
@@ -99,6 +102,7 @@ class UnorderedKNN:
                     engine=cfg.engine, query_tile=cfg.query_tile,
                     point_tile=cfg.point_tile, bucket_size=cfg.bucket_size,
                     point_group=cfg.point_group,
+                    score_dtype=cfg.score_dtype,
                     return_candidates=return_neighbors, return_stats=True)
             if return_neighbors:
                 dists, cands, self.last_stats = got

@@ -9,16 +9,24 @@ arXiv:2206.14286). This module is that engine: for each (query-tile,
 point-tile) pair compute the full f32 squared-distance tile and fold it into
 the persistent candidate state.
 
-Exactness: dist2 is computed as ``(dx*dx + dy*dy) + dz*dz`` on f32 operands —
-the same value the reference's traversal computes per visited point — NOT via
-the ``|q|^2 + |p|^2 - 2 q.p`` MXU trick, whose cancellation error is
-unbounded relative to the direct form. For 3-component points the MXU would
-run at K=3/128 utilization anyway, so the VPU outer-difference form is both
-the exact and the fast choice on TPU. (Selection itself is exact — no
-accumulation across pairs — but XLA may contract ``a*b + c`` into FMA
-differently per fusion context, so distances agree across *engines* to
-<= 1 ulp, not always bit-for-bit; within one engine results are
-deterministic.)
+Exactness: by default dist2 is computed elementwise on f32 operands (fixed
+left-to-right component order — at D=3 the exact ``(dx*dx + dy*dy) + dz*dz``
+tree) — the same value the reference's traversal computes per visited
+point. ``score_dtype="bf16"`` switches to the ``|q|^2 + |p|^2 - 2 q.p``
+MXU form (ops/distance.py): the cross term is one bf16 dot_general with
+f32 accumulation, and because the expansion's cancellation error is
+unbounded relative to the direct form, the approx scores only SELECT the
+top ``rescore_width(k)`` survivors per row, which are rescored with the
+exact elementwise f32 form before the merge — values entering the
+candidate state are never approximate. At D=3 the MXU would run at K=3/128
+utilization, so f32/VPU stays the default; the matmul form is the high-D
+lever. (Selection itself is exact — no accumulation across pairs — but XLA
+may contract ``a*b + c`` into FMA differently per fusion context, so
+distances agree across *engines* to <= 1 ulp, not always bit-for-bit;
+within one engine results are deterministic.)
+
+The layout is D-generic throughout: points are ``f32[N, D]`` and the tile
+reshapes derive D from the inputs (the PAD_SENTINEL padding path included).
 
 A kd-tree traversal engine also exists (ops/traverse.py) and is benchmarked
 against this one; sentinel-padded tiles cost O(N) per query here vs O(log N)
@@ -32,16 +40,18 @@ import jax.numpy as jnp
 
 from mpi_cuda_largescaleknn_tpu.core.types import PAD_SENTINEL, CandidateState
 from mpi_cuda_largescaleknn_tpu.ops.candidates import merge_candidates
+from mpi_cuda_largescaleknn_tpu.ops.distance import (
+    elementwise_dist2,
+    score_tile,
+    validate_score_dtype,
+)
 from mpi_cuda_largescaleknn_tpu.utils.math import cdiv
 
 
 def pairwise_dist2(q: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
-    """f32[Tq,3] x f32[Tp,3] -> f32[Tq,Tp] squared distances, fixed
-    summation order x,y,z."""
-    dx = q[:, 0:1] - p[None, :, 0]
-    dy = q[:, 1:2] - p[None, :, 1]
-    dz = q[:, 2:3] - p[None, :, 2]
-    return (dx * dx + dy * dy) + dz * dz
+    """f32[Tq,D] x f32[Tp,D] -> f32[Tq,Tp] squared distances, fixed
+    left-to-right component order (x,y,z at D=3)."""
+    return elementwise_dist2(q, p)
 
 
 def _pad_rows(arr, target, fill):
@@ -54,18 +64,22 @@ def _pad_rows(arr, target, fill):
 
 def knn_update_bruteforce(state: CandidateState, queries: jnp.ndarray,
                           points: jnp.ndarray, point_ids: jnp.ndarray | None = None,
-                          *, query_tile: int = 2048, point_tile: int = 2048
-                          ) -> CandidateState:
+                          *, query_tile: int = 2048, point_tile: int = 2048,
+                          score_dtype: str = "f32") -> CandidateState:
     """Fold every ``points`` row into each query's candidate state.
 
     Equivalent to one ``runQuery`` kernel launch of the reference
     (unorderedDataVariant.cu:199-203): queries and state stay put, ``points``
     is whatever tree shard is resident this round. Sentinel-padded rows in
     either input are harmless (their distances are +inf / their results are
-    discarded by the caller).
+    discarded by the caller). ``score_dtype="bf16"`` scores each [Tq, Tp]
+    tile on the MXU with an exact f32 rescore of the survivors
+    (ops/distance.py — the module docstring has the exactness argument).
     """
+    validate_score_dtype(score_dtype)
     num_q, k = state.dist2.shape
     num_p = points.shape[0]
+    dim = queries.shape[-1]
     if point_ids is None:
         point_ids = jnp.arange(num_p, dtype=jnp.int32)
 
@@ -82,8 +96,8 @@ def knn_update_bruteforce(state: CandidateState, queries: jnp.ndarray,
     d2_pad = _pad_rows(state.dist2, nq_tiles * qt, jnp.inf)
     idx_pad = _pad_rows(state.idx, nq_tiles * qt, -1)
 
-    q_tiles = q_pad.reshape(nq_tiles, qt, 3)
-    p_tiles = p_pad.reshape(np_tiles, pt, 3)
+    q_tiles = q_pad.reshape(nq_tiles, qt, dim)
+    p_tiles = p_pad.reshape(np_tiles, pt, dim)
     id_tiles = id_pad.reshape(np_tiles, pt)
     d2_tiles = d2_pad.reshape(nq_tiles, qt, k)
     idx_tiles = idx_pad.reshape(nq_tiles, qt, k)
@@ -94,8 +108,8 @@ def knn_update_bruteforce(state: CandidateState, queries: jnp.ndarray,
         def step(carry, tile):
             st = CandidateState(*carry)
             p_t, id_t = tile
-            d2 = pairwise_dist2(q, p_t)
-            st = merge_candidates(st, d2, jnp.broadcast_to(id_t[None, :], d2.shape))
+            d2, ids = score_tile(q, p_t, id_t, k, score_dtype=score_dtype)
+            st = merge_candidates(st, d2, ids)
             return (st.dist2, st.idx), None
 
         (hd2, hidx), _ = jax.lax.scan(step, (hd2, hidx), (p_tiles, id_tiles))

@@ -11,6 +11,15 @@ from __future__ import annotations
 import jax
 
 
+def tpu_compiler_params(pltpu, **kwargs):
+    """Build Mosaic compiler params across jax pins: current jax names the
+    class ``CompilerParams``, older pins ``TPUCompilerParams``. One more
+    drift bridge in the utils/compat.py spirit — call sites stay clean."""
+    cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams")
+    return cls(**kwargs)
+
+
 def is_tpu_backend() -> bool:
     """True when the default backend is real TPU hardware — including the
     ``axon`` PJRT tunnel, whose platform name is not ``tpu`` but whose

@@ -28,6 +28,9 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from mpi_cuda_largescaleknn_tpu.core.types import PAD_SENTINEL, CandidateState
+from mpi_cuda_largescaleknn_tpu.ops.distance import accumulate_sq
+from mpi_cuda_largescaleknn_tpu.ops.pallas import tpu_compiler_params
+from mpi_cuda_largescaleknn_tpu.utils.compat import shape_dtype_struct
 from mpi_cuda_largescaleknn_tpu.utils.math import cdiv
 
 
@@ -176,11 +179,14 @@ def _kernel(q_ref, pt_ref, in_d2_ref, in_idx_ref,
         out_d2_ref[:] = in_d2_ref[:]
         out_idx_ref[:] = in_idx_ref[:]
 
-    q = q_ref[:]                                   # [S, 3]
-    dx = q[:, 0:1] - pt_ref[0:1, :]                # [S, T]
-    dy = q[:, 1:2] - pt_ref[1:2, :]
-    dz = q[:, 2:3] - pt_ref[2:3, :]
-    d2 = (dx * dx + dy * dy) + dz * dz
+    q = q_ref[:]                                   # [S, D]
+    # left-to-right accumulate with the opaque-1.0 contraction guard
+    # (ops/distance.py accumulate_sq) so kernel bits match the XLA scorer
+    one = q[0, 0] * 0.0 + 1.0
+    d2 = None                                      # [S, T]
+    for i in range(q.shape[-1]):                   # static unroll over D
+        di = q[:, i:i + 1] - pt_ref[i:i + 1, :]
+        d2 = accumulate_sq(d2, di, one)
 
     cd2, cidx = fold_tile_into_candidates(d2, j * point_tile, out_d2_ref[:],
                                           out_idx_ref[:],
@@ -194,6 +200,7 @@ def _kernel(q_ref, pt_ref, in_d2_ref, in_idx_ref,
 def _run(q_pad, p_t, in_d2, in_idx, *, query_tile, point_tile,
          interpret, fold_segments):
     nq, k = in_d2.shape
+    dim = q_pad.shape[1]
     npts = p_t.shape[1]
     grid = (nq // query_tile, npts // point_tile)
     out_d2, out_idx = pl.pallas_call(
@@ -201,9 +208,9 @@ def _run(q_pad, p_t, in_d2, in_idx, *, query_tile, point_tile,
                           fold_segments=fold_segments),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((query_tile, 3), lambda i, j: (i, 0),
+            pl.BlockSpec((query_tile, dim), lambda i, j: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((3, point_tile), lambda i, j: (0, j),
+            pl.BlockSpec((dim, point_tile), lambda i, j: (0, j),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((query_tile, k), lambda i, j: (i, 0),
                          memory_space=pltpu.VMEM),
@@ -219,14 +226,11 @@ def _run(q_pad, p_t, in_d2, in_idx, *, query_tile, point_tile,
         out_shape=(
             # under shard_map the outputs vary over the same mesh axes as the
             # candidate state; outside, vma is empty and this is a no-op
-            jax.ShapeDtypeStruct((nq, k), jnp.float32,
-                                 vma=getattr(jax.typeof(in_d2), "vma",
-                                             frozenset())),
-            jax.ShapeDtypeStruct((nq, k), jnp.int32,
-                                 vma=getattr(jax.typeof(in_idx), "vma",
-                                             frozenset())),
+            # (utils/compat.py drops the typing on jax pins without it)
+            shape_dtype_struct((nq, k), jnp.float32, like=in_d2),
+            shape_dtype_struct((nq, k), jnp.int32, like=in_idx),
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(pltpu,
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(q_pad, p_t, in_d2, in_idx)

@@ -38,6 +38,8 @@ from jax.experimental.pallas import tpu as pltpu
 from jax import lax
 
 from mpi_cuda_largescaleknn_tpu.core.types import PAD_SENTINEL, CandidateState
+from mpi_cuda_largescaleknn_tpu.ops.distance import accumulate_sq, split_bf16
+from mpi_cuda_largescaleknn_tpu.ops.pallas import tpu_compiler_params
 from mpi_cuda_largescaleknn_tpu.ops.pallas.knn_bf import (
     default_fold_segments,
     fold_tile_into_candidates,
@@ -46,6 +48,7 @@ from mpi_cuda_largescaleknn_tpu.ops.partition import (
     BucketedPoints,
     nearest_first_order,
 )
+from mpi_cuda_largescaleknn_tpu.utils.compat import shape_dtype_struct
 
 
 def _kernel(order_ref, boxd2_ref,            # SMEM: [1, 1, Bp] i32 / f32
@@ -56,15 +59,16 @@ def _kernel(order_ref, boxd2_ref,            # SMEM: [1, 1, Bp] i32 / f32
             out_d2_ref, out_idx_ref,         # VMEM: [S, k]
             vis_ref,                         # SMEM: [1,1,2] i32 [visits,
                                              #        fold passes]
-            p_buf, sem_p,                    # scratch: [2,4,V*T], (2,V)
+            p_buf, sem_p,                    # scratch: [2,rows,V*T], (2,V)
             *, visit_batch, self_group,
-            fold_segments):
+            fold_segments, score_mxu=False):
     num_pb = p_hbm.shape[0]
     t_p = p_hbm.shape[2]
     v_b = visit_batch
     num_chunks = (num_pb + v_b - 1) // v_b
     kk = in_d2_ref.shape[-1]
-    q = q_ref[0]                             # [S, 3]
+    q = q_ref[0]                             # [S, D]
+    dim = q.shape[-1]
     # [S, 1] column layout so the bool mask never needs a minor-dim
     # insertion (Mosaic supports those only for 32-bit types)
     qvalid = qid_ref[0] >= 0                 # [S, 1]
@@ -127,11 +131,44 @@ def _kernel(order_ref, boxd2_ref,            # SMEM: [1, 1, Bp] i32 / f32
             start_chunk(lax.rem(c + 1, 2), c + 1)
 
         wait_chunk(slot, c)
-        p = p_buf[slot]                       # [4, V*T]; row 3 is tiling pad
-        dx = q[:, 0:1] - p[0:1, :]
-        dy = q[:, 1:2] - p[1:2, :]
-        dz = q[:, 2:3] - p[2:3, :]
-        d2 = (dx * dx + dy * dy) + dz * dz    # [S, V*T]
+        p = p_buf[slot]                       # [rows, V*T]; row D is ||p||^2
+        if score_mxu:
+            # matmul-form score (TPU-KNN): qn2 + pn2 - 2 q.p — the cross
+            # term rides the MXU as the bf16x3 split (hi.hi + hi.lo +
+            # lo.hi, three dot_generals with f32 accumulation, ~16
+            # mantissa bits — one-pass bf16 measurably drops true top-k
+            # members, ops/distance.py mxu_scores); both norms ride exact
+            # f32 (||p||^2 was stowed in row D of the resident layout by
+            # the wrapper). Approximate scores only SELECT candidates
+            # here — the wrapper rescores every adopted entry with the
+            # exact elementwise form after the kernel, against WIDENED
+            # candidate rows (kk = rescore width)
+            qn2 = None
+            for i in range(dim):              # static unroll over D
+                qi = q[:, i:i + 1]
+                qn2 = qi * qi if qn2 is None else qn2 + qi * qi
+            pc = p[0:dim, :]
+            qh, ql = split_bf16(q)
+            ph, plo = split_bf16(pc)
+            dn = (((1,), (0,)), ((), ()))
+            cross = (lax.dot_general(qh, ph, dn,
+                                     preferred_element_type=jnp.float32)
+                     + lax.dot_general(qh, plo, dn,
+                                       preferred_element_type=jnp.float32)
+                     + lax.dot_general(ql, ph, dn,
+                                       preferred_element_type=jnp.float32))
+            d2 = qn2 + p[dim:dim + 1, :] - 2.0 * cross     # [S, V*T]
+        else:
+            # exact elementwise, fixed left-to-right order, every square
+            # carried through the opaque-1.0 contraction guard so the
+            # kernel's bits match the XLA scorer's in every context
+            # (ops/distance.py accumulate_sq; `one` derives from runtime
+            # query data because Mosaic has no optimization_barrier)
+            one = q[0, 0] * 0.0 + 1.0
+            d2 = None
+            for i in range(dim):
+                di = q[:, i:i + 1] - p[i:i + 1, :]
+                d2 = accumulate_sq(d2, di, one)
         # per-VISIT pruning inside the chunk (the per-node prune of
         # cukd::stackFree::knn, unorderedDataVariant.cu:86, recovered at
         # bucket granularity): a bucket whose box distance is at or beyond
@@ -214,17 +251,20 @@ def _vmem_limit(s_q: int, t_p: int, visit_batch: int, k: int) -> int:
 
 
 @functools.partial(jax.jit, static_argnames=("interpret", "visit_batch",
-                                             "self_group", "fold_segments"))
+                                             "self_group", "fold_segments",
+                                             "score_mxu"))
 def _run(order, boxd2, sskip, q_pts, q_ids, in_d2, in_idx, p_t, *,
-         interpret, visit_batch, self_group, fold_segments):
-    num_qb, s_q, _one = q_ids.shape
+         interpret, visit_batch, self_group, fold_segments,
+         score_mxu=False):
+    num_qb, s_q, dim = q_pts.shape
     num_pb, _, t_p = p_t.shape
     k = in_d2.shape[-1]
     grid = (num_qb,)
     out_d2, out_idx, visits = pl.pallas_call(
         functools.partial(_kernel, visit_batch=visit_batch,
                           self_group=self_group,
-                          fold_segments=fold_segments),
+                          fold_segments=fold_segments,
+                          score_mxu=score_mxu),
         grid=grid,
         in_specs=[
             # Mosaic requires the LAST TWO block dims to be sublane/lane
@@ -236,7 +276,7 @@ def _run(order, boxd2, sskip, q_pts, q_ids, in_d2, in_idx, p_t, *,
                          memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1, 1), lambda b: (0, 0, 0),
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, s_q, 3), lambda b: (b, 0, 0),
+            pl.BlockSpec((1, s_q, dim), lambda b: (b, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, s_q, 1), lambda b: (b, 0, 0),
                          memory_space=pltpu.VMEM),
@@ -257,21 +297,16 @@ def _run(order, boxd2, sskip, q_pts, q_ids, in_d2, in_idx, p_t, *,
         out_shape=(
             # under shard_map the outputs vary over the same mesh axes as the
             # candidate state; outside, vma is empty and this is a no-op
-            jax.ShapeDtypeStruct((num_qb * s_q, k), jnp.float32,
-                                 vma=getattr(jax.typeof(in_d2), "vma",
-                                             frozenset())),
-            jax.ShapeDtypeStruct((num_qb * s_q, k), jnp.int32,
-                                 vma=getattr(jax.typeof(in_idx), "vma",
-                                             frozenset())),
-            jax.ShapeDtypeStruct((num_qb, 1, 2), jnp.int32,
-                                 vma=getattr(jax.typeof(in_idx), "vma",
-                                             frozenset())),
+            # (utils/compat.py drops the typing on jax pins without it)
+            shape_dtype_struct((num_qb * s_q, k), jnp.float32, like=in_d2),
+            shape_dtype_struct((num_qb * s_q, k), jnp.int32, like=in_idx),
+            shape_dtype_struct((num_qb, 1, 2), jnp.int32, like=in_idx),
         ),
         scratch_shapes=[
             pltpu.VMEM((2, p_t.shape[1], visit_batch * t_p), jnp.float32),
             pltpu.SemaphoreType.DMA((2, visit_batch)),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(pltpu,
             dimension_semantics=("arbitrary",),
             # the [S, V*T] distance tile + double-buffered chunk scratch put
             # ~19MB on the VMEM stack at the 1M config, beyond the 16MB
@@ -290,7 +325,9 @@ def knn_update_tiled_pallas(state: CandidateState, q: BucketedPoints,
                             with_stats: bool | str = False,
                             visit_batch: int | None = None,
                             skip_self=None, self_group: int = 1,
-                            canonical_ties: bool = False):
+                            canonical_ties: bool = False,
+                            score_dtype: str = "f32",
+                            point_norms2=None):
     """Drop-in Pallas twin of ``ops.tiled.knn_update_tiled`` (same contract:
     state rows in ``q``'s bucket order; folds every real point of ``p`` in;
     ``with_stats`` additionally returns the i32 count of [S, T] tiles
@@ -305,6 +342,22 @@ def knn_update_tiled_pallas(state: CandidateState, q: BucketedPoints,
     ``skip_self``/``self_group`` as in the twin: nonzero masks point bucket
     b // self_group out of query bucket b's traversal for warm-started
     self-joins).
+
+    ``score_dtype="bf16"``: the in-kernel distance tile becomes the
+    matmul-form MXU score (one bf16 dot_general per chunk, f32
+    accumulation, ||p||^2 stowed in the resident layout's spare sublane
+    row) and the candidate rows are WIDENED to ``rescore_width(k)`` slots —
+    the kernel's fold then keeps the top-W survivors per row BY APPROX
+    SCORE, and this wrapper rescores every adopted entry with the exact
+    elementwise f32 form before sorting the rows back down to k. Emitted
+    distances are therefore always exact; the kept SET matches the f32
+    kernel whenever the true top-k sits inside the survivor window (the
+    same guarantee as the XLA twin's bf16 mode — docs/TUNING.md "Distance
+    kernel"). In-kernel pruning compares against the widened row's LAST
+    slot, which is conservative (never prunes a bucket the f32 kernel
+    would have visited). ``point_norms2`` optionally carries precomputed
+    f32[Bp, T] squared norms (the serving engine computes them once at
+    index upload).
 
     ``canonical_ties``: re-sort the finished candidate rows by the
     (dist2, idx) total order — the serving engine's multi-bucket tie
@@ -322,25 +375,61 @@ def knn_update_tiled_pallas(state: CandidateState, q: BucketedPoints,
     pad sentinel is ``-1``). Values ``<= -2`` would alias the fold's
     lane-position encoding and decode to unrelated ids
     (fold_tile_into_candidates)."""
+    from mpi_cuda_largescaleknn_tpu.ops.distance import (
+        mxu_min_dim,
+        rescore_width,
+        validate_score_dtype,
+    )
+
+    validate_score_dtype(score_dtype)
+    use_mxu = (score_dtype == "bf16"
+               and q.pts.shape[-1] >= mxu_min_dim())
     if interpret is None:
         from mpi_cuda_largescaleknn_tpu.ops.pallas import is_tpu_backend
         interpret = not is_tpu_backend()
     num_qb, s_q = q.ids.shape
+    dim = q.pts.shape[-1]
     k = state.dist2.shape[-1]
 
     sorted_d2, order = nearest_first_order(q.lower, q.upper,
                                            p.lower, p.upper)  # [Bq, Bp] x2
 
     # Mosaic DMA-slices p_hbm per bucket, so the sliced dims must match its
-    # VMEM tiling: the coordinate dim rides the sublane axis (tiled in 4s for
-    # a 3-row f32 array — pad to 4, kernel ignores row 3) and the bucket dim
+    # VMEM tiling: the coordinate dim rides the sublane axis (D rows, then
+    # one ||p||^2 row, padded up to a multiple of 4) and the bucket dim
     # rides the lane axis (tiled in 128s — pad with the same PAD_SENTINEL/-1
     # rows partition_points uses; their distances overflow to +inf and are
     # never adopted by the fold)
-    p3 = jnp.swapaxes(p.pts, 1, 2)            # [Bp, 3, T]
+    p3 = jnp.swapaxes(p.pts, 1, 2)            # [Bp, D, T]
     lane_pad = (-p3.shape[2]) % 128
-    p_t = jnp.pad(p3, ((0, 0), (0, 1), (0, lane_pad)),
-                  constant_values=PAD_SENTINEL)
+    if lane_pad:
+        p3 = jnp.pad(p3, ((0, 0), (0, 0), (0, lane_pad)),
+                     constant_values=PAD_SENTINEL)
+    # row D carries the exact f32 ||p||^2 per lane for the MXU score (the
+    # previously unused tiling-pad row). Computed AFTER lane padding (or
+    # +inf-padded when precomputed) so pad lanes overflow to +inf and can
+    # never win a survivor slot. The f32 kernel never reads the row, so
+    # the default mode keeps the old PAD_SENTINEL fill instead of paying
+    # the O(Bp*T*D) norm compute on every call
+    if not use_mxu:
+        pn2 = jnp.full((p3.shape[0], p3.shape[2]), PAD_SENTINEL,
+                       jnp.float32)
+    elif point_norms2 is not None:
+        pn2 = jnp.asarray(point_norms2, jnp.float32)
+        if lane_pad:
+            pn2 = jnp.pad(pn2, ((0, 0), (0, lane_pad)),
+                          constant_values=jnp.inf)
+    else:
+        pn2 = None
+        for i in range(dim):
+            ri = p3[:, i, :]
+            pn2 = ri * ri if pn2 is None else pn2 + ri * ri
+    row_pad = (-(dim + 1)) % 4
+    parts = [p3, pn2[:, None, :]]
+    if row_pad:
+        parts.append(jnp.full((p3.shape[0], row_pad, p3.shape[2]),
+                              PAD_SENTINEL, jnp.float32))
+    p_t = jnp.concatenate(parts, axis=1)      # [Bp, rows, T_pad]
     # id table for the post-kernel position decode (ids never enter the
     # kernel — see fold_tile_into_candidates); pad lanes decode to -1 but
     # are never adopted anyway (their coords are PAD_SENTINEL -> +inf d2)
@@ -350,6 +439,23 @@ def knn_update_tiled_pallas(state: CandidateState, q: BucketedPoints,
 
     assert state.dist2.shape == (num_qb * s_q, k), (state.dist2.shape,
                                                     (num_qb, s_q, k))
+    # widened candidate rows under the MXU score: the kernel's fold keeps
+    # rescore_width(k) survivors per row by approx score, rescored and
+    # sliced back to k after the kernel. The +inf fill preserves the
+    # max_radius cutoff semantics: original slots (<= r^2) always sort
+    # ahead of any widened-slot candidate at or beyond the radius
+    k_eff = k
+    if use_mxu:
+        k_eff = rescore_width(k, p_t.shape[0] * p_t.shape[2])
+        if k_eff > k:
+            rows = num_qb * s_q
+            state = CandidateState(
+                jnp.concatenate([state.dist2,
+                                 jnp.full((rows, k_eff - k), jnp.inf,
+                                          jnp.float32)], axis=1),
+                jnp.concatenate([state.idx,
+                                 jnp.full((rows, k_eff - k), -1,
+                                          jnp.int32)], axis=1))
     if visit_batch is None:
         # enough lanes per chunk to amortize the loop step (~2048) without
         # blowing the VMEM budget on the [S, V*T] distance tile.
@@ -365,7 +471,7 @@ def knn_update_tiled_pallas(state: CandidateState, q: BucketedPoints,
     # segment per pass (fold_tile_into_candidates). LSK_FOLD_SEGS
     # overrides (trace-time, like LSK_CHUNK_LANES)
     lanes_total = visit_batch * p_t.shape[2]
-    fold_segs = default_fold_segments(lanes_total, k, env="LSK_FOLD_SEGS")
+    fold_segs = default_fold_segments(lanes_total, k_eff, env="LSK_FOLD_SEGS")
     ss = jnp.asarray(0 if skip_self is None else skip_self,
                      jnp.int32).reshape(1, 1, 1)
     out_d2, out_idx, visits = _run(order[:, None, :], sorted_d2[:, None, :],
@@ -374,18 +480,48 @@ def knn_update_tiled_pallas(state: CandidateState, q: BucketedPoints,
                                    interpret=interpret,
                                    visit_batch=visit_batch,
                                    self_group=self_group,
-                                   fold_segments=fold_segs)
+                                   fold_segments=fold_segs,
+                                   score_mxu=use_mxu)
     # decode encoded lane positions (<= -2) through the per-query-bucket
     # visit order: pos // T names the visit slot, pos % T the lane within
     # the visited bucket. Entries carried in from prior rounds / warm
     # starts are real ids (>= -1) and pass through untouched.
     t_pad = p_t.shape[2]
-    enc = out_idx.reshape(num_qb, s_q * k)
+    enc = out_idx.reshape(num_qb, s_q * k_eff)
     pos = jnp.clip(-2 - enc, 0, p_t.shape[0] * t_pad - 1)
-    bucket = jnp.take_along_axis(order, pos // t_pad, axis=1)
-    ids_new = jnp.take(pid.reshape(-1), bucket * t_pad + pos % t_pad, axis=0)
+    flat_pos = jnp.take_along_axis(order, pos // t_pad, axis=1) * t_pad \
+        + pos % t_pad
+    ids_new = jnp.take(pid.reshape(-1), flat_pos, axis=0)
     out_idx = jnp.where(enc <= -2, ids_new, enc).reshape(out_idx.shape)
-    if canonical_ties:
+    if use_mxu:
+        # exact f32 rescore of every entry the fold adopted by approx
+        # score: gather the survivor coordinates back through the same
+        # position decode and recompute the elementwise distance (the f32
+        # kernel's expression tree), then sort the widened rows and slice
+        # back to k. Entries carried in from prior rounds kept their exact
+        # distances inside the kernel and pass through unchanged.
+        from mpi_cuda_largescaleknn_tpu.ops.distance import opaque_one
+
+        pflat = jnp.swapaxes(p_t[:, :dim, :], 1, 2).reshape(-1, dim)
+        pg = jnp.take(pflat, flat_pos, axis=0).reshape(num_qb, s_q,
+                                                       k_eff, dim)
+        one = opaque_one(q.pts)
+        acc = None
+        for i in range(dim):
+            acc = accumulate_sq(acc, q.pts[:, :, None, i] - pg[..., i], one)
+        d2_new = jnp.where(enc <= -2, acc.reshape(num_qb, s_q * k_eff),
+                           out_d2.reshape(num_qb, s_q * k_eff))
+        d2r = d2_new.reshape(num_qb * s_q, k_eff)
+        idr = out_idx.reshape(num_qb * s_q, k_eff)
+        # values changed, so re-sort before slicing: stable 1-key keeps
+        # the fold's arrival order among exact ties (the kernel's
+        # documented boundary discipline); canonical mode uses the
+        # (dist2, idx) total order like the XLA twin
+        d2r, idr = lax.sort((d2r, idr),
+                            num_keys=2 if canonical_ties else 1,
+                            dimension=1, is_stable=True)
+        out_d2, out_idx = d2r[:, :k], idr[:, :k]
+    elif canonical_ties:
         # one [rows, k] two-key sort per call (not per visit): rows come
         # back ascending (dist2, idx) like the XLA twin's canonical mode
         out_d2, out_idx = lax.sort((out_d2, out_idx), num_keys=2,

@@ -43,10 +43,10 @@ class BucketedPoints(NamedTuple):
     in bucket order can be scattered back.
     """
 
-    pts: jnp.ndarray    # f32[B, S, 3]
+    pts: jnp.ndarray    # f32[B, S, D]
     ids: jnp.ndarray    # i32[B, S]
-    lower: jnp.ndarray  # f32[B, 3] (+inf rows for empty buckets)
-    upper: jnp.ndarray  # f32[B, 3] (-inf rows for empty buckets)
+    lower: jnp.ndarray  # f32[B, D] (+inf rows for empty buckets)
+    upper: jnp.ndarray  # f32[B, D] (-inf rows for empty buckets)
     pos: jnp.ndarray    # i32[B, S] row index into the input array, -1 = pad
 
     @property
@@ -67,25 +67,29 @@ def choose_buckets(n: int, bucket_size_target: int) -> tuple[int, int]:
 
 
 @functools.partial(jax.jit, static_argnames=("num_buckets", "bucket_size"))
-def _partition_level(x, y, z, ids, pos, num_seg, *, num_buckets, bucket_size):
+def _partition_level(*arrs, num_buckets, bucket_size):
     """One median-split level: stable 2-key sort by (segment, split coord).
 
-    ``num_seg`` is a TRACED scalar so all levels share one compiled program.
-    The split dimension is each segment's widest real-point extent; extents
-    are computed shape-uniformly by reducing the static [B, S] fine-bucket
-    grid first and then segment-min/maxing fine buckets into the level's
-    coarser segments (segment boundaries always align with fine buckets
-    because num_seg divides B). Values are identical to a direct
-    [num_seg, seg]-shaped reduction, so the sort keys — and therefore the
-    output, tie order included — are unchanged from the per-level-shape
-    form this replaces.
+    ``arrs`` is ``(*coords, ids, pos, num_seg)`` — one column per point
+    dimension (D-generic; D=3 reproduces the original x/y/z form bit for
+    bit), then ids, pos, and the TRACED segment count so all levels share
+    one compiled program. The split dimension is each segment's widest
+    real-point extent; extents are computed shape-uniformly by reducing the
+    static [B, S] fine-bucket grid first and then segment-min/maxing fine
+    buckets into the level's coarser segments (segment boundaries always
+    align with fine buckets because num_seg divides B). Values are
+    identical to a direct [num_seg, seg]-shaped reduction, so the sort
+    keys — and therefore the output, tie order included — are unchanged
+    from the per-level-shape form this replaces.
     """
-    n_tot = x.shape[0]
+    cols, ids, pos, num_seg = arrs[:-3], arrs[-3], arrs[-2], arrs[-1]
+    d = len(cols)
+    n_tot = cols[0].shape[0]
     seg_id = jnp.arange(n_tot, dtype=jnp.int32) // (n_tot // num_seg)
 
-    coords = jnp.stack([x, y, z], axis=1).reshape(num_buckets, bucket_size, 3)
+    coords = jnp.stack(cols, axis=1).reshape(num_buckets, bucket_size, d)
     valid = coords[:, :, 0:1] < PAD_SENTINEL / 2
-    lo_f = jnp.min(jnp.where(valid, coords, jnp.inf), axis=1)     # [B, 3]
+    lo_f = jnp.min(jnp.where(valid, coords, jnp.inf), axis=1)     # [B, D]
     hi_f = jnp.max(jnp.where(valid, coords, -jnp.inf), axis=1)
     seg_of_fine = (jnp.arange(num_buckets, dtype=jnp.int32)
                    // (num_buckets // num_seg))
@@ -99,16 +103,18 @@ def _partition_level(x, y, z, ids, pos, num_seg, *, num_buckets, bucket_size):
     # folding alone cost ~30 s at the 1M-point shape
     dim_e = jnp.broadcast_to(dim[seg_of_fine][:, None],
                              (num_buckets, bucket_size)).reshape(-1)
-    key = jnp.where(dim_e == 0, x, jnp.where(dim_e == 1, y, z))
+    key = cols[d - 1]
+    for i in range(d - 2, -1, -1):   # nested select, widest-dim column wins
+        key = jnp.where(dim_e == i, cols[i], key)
 
-    _, _, x, y, z, ids, pos = lax.sort(
-        (seg_id, key, x, y, z, ids, pos), num_keys=2, is_stable=True)
-    return x, y, z, ids, pos
+    out = lax.sort((seg_id, key) + tuple(cols) + (ids, pos),
+                   num_keys=2, is_stable=True)
+    return out[2:]
 
 
 def partition_points(points: jnp.ndarray, point_ids: jnp.ndarray | None = None,
                      *, bucket_size: int = 512) -> BucketedPoints:
-    """Partition ``f32[N,3]`` into ``B`` contiguous median-split buckets.
+    """Partition ``f32[N,D]`` into ``B`` contiguous median-split buckets.
 
     Each of the ``log2 B`` levels is one stable multi-operand ``lax.sort``
     keyed by (segment-id, coordinate along the segment's widest extent) —
@@ -140,28 +146,31 @@ def partition_points(points: jnp.ndarray, point_ids: jnp.ndarray | None = None,
 
 
 def partition_prep(points, point_ids, *, num_buckets, bucket_size):
-    """Stage 1 of the split partition: pad + column-split to the 5 sorted
-    arrays ``(x, y, z, ids, pos)``. ``num_buckets``/``bucket_size`` come
+    """Stage 1 of the split partition: pad + column-split to the D+2 sorted
+    arrays ``(*coords, ids, pos)``. ``num_buckets``/``bucket_size`` come
     from ``choose_buckets``."""
     points = jnp.asarray(points, jnp.float32)
-    n = points.shape[0]
+    n, d = points.shape
     if point_ids is None:
         point_ids = jnp.arange(n, dtype=jnp.int32)
     point_ids = jnp.asarray(point_ids, jnp.int32)
     pad = num_buckets * bucket_size - n
 
-    x = jnp.concatenate([points[:, 0], jnp.full((pad,), PAD_SENTINEL, jnp.float32)])
-    y = jnp.concatenate([points[:, 1], jnp.full((pad,), PAD_SENTINEL, jnp.float32)])
-    z = jnp.concatenate([points[:, 2], jnp.full((pad,), PAD_SENTINEL, jnp.float32)])
+    cols = tuple(
+        jnp.concatenate([points[:, i],
+                         jnp.full((pad,), PAD_SENTINEL, jnp.float32)])
+        for i in range(d))
     ids = jnp.concatenate([point_ids, jnp.full((pad,), -1, jnp.int32)])
     pos = jnp.concatenate([jnp.arange(n, dtype=jnp.int32),
                            jnp.full((pad,), -1, jnp.int32)])
-    return x, y, z, ids, pos
+    return cols + (ids, pos)
 
 
-def partition_finalize(x, y, z, ids, pos, *, num_buckets, bucket_size):
+def partition_finalize(*arrs, num_buckets, bucket_size):
     """Stage 3: reshape the fully-sorted columns into buckets + AABBs."""
-    pts = jnp.stack([x, y, z], axis=1).reshape(num_buckets, bucket_size, 3)
+    cols, ids, pos = arrs[:-2], arrs[-2], arrs[-1]
+    pts = jnp.stack(cols, axis=1).reshape(num_buckets, bucket_size,
+                                          len(cols))
     ids = ids.reshape(num_buckets, bucket_size)
     pos = pos.reshape(num_buckets, bucket_size)
 
@@ -217,13 +226,14 @@ def coarsen_buckets(q: BucketedPoints, group: int) -> BucketedPoints:
     if group == 1:
         return q
     b, s = q.ids.shape
+    d = q.pts.shape[-1]
     assert b % group == 0, (b, group)
     bc = b // group
     return BucketedPoints(
-        q.pts.reshape(bc, group * s, 3),
+        q.pts.reshape(bc, group * s, d),
         q.ids.reshape(bc, group * s),
-        q.lower.reshape(bc, group, 3).min(axis=1),
-        q.upper.reshape(bc, group, 3).max(axis=1),
+        q.lower.reshape(bc, group, d).min(axis=1),
+        q.upper.reshape(bc, group, d).max(axis=1),
         q.pos.reshape(bc, group * s))
 
 

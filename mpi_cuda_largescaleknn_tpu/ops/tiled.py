@@ -18,11 +18,16 @@ prune-ordered traversal is lifted to *tile* granularity:
 - the loop ends when every query bucket's next-nearest unvisited bucket is
   already beyond its radius — per-device early exit with no host round trip.
 
-Within a visited bucket pair the work is a dense [S, T] f32 distance tile
-folded into the persistent candidate rows — perfectly regular VPU work. For
-n uniform points this does O(visited_buckets * S * T) ~ O(k + surface)
-distance evaluations per query instead of brute force's O(n), while keeping
-every op a static-shape tile.
+Within a visited bucket pair the work is a dense [S, T] score tile folded
+into the persistent candidate rows — perfectly regular VPU work under the
+default exact elementwise scorer, or MXU matmuls under
+``score_dtype="bf16"`` (the ‖q‖²+‖p‖²−2q·p expansion with an exact f32
+rescore of the survivors — ops/distance.py; final results bit-identical
+whenever the true top-k sits inside the rescore window, which everything
+short of engineered sub-bf16-ulp tie classes does — docs/TUNING.md
+"Distance kernel" has the bound). For n uniform points this does
+O(visited_buckets * S * T) ~ O(k + surface) distance evaluations per query
+instead of brute force's O(n), while keeping every op a static-shape tile.
 """
 
 from __future__ import annotations
@@ -37,6 +42,13 @@ from mpi_cuda_largescaleknn_tpu.core.types import CandidateState
 from mpi_cuda_largescaleknn_tpu.ops.candidates import (
     init_candidates,
     merge_candidates,
+)
+from mpi_cuda_largescaleknn_tpu.ops.distance import (
+    elementwise_dist2,
+    mxu_min_dim,
+    norms2,
+    score_tile,
+    validate_score_dtype,
 )
 from mpi_cuda_largescaleknn_tpu.ops.partition import (
     BucketedPoints,
@@ -98,11 +110,8 @@ def warm_start_self(q: BucketedPoints, k: int,
     hidx = init.idx.reshape(num_qb, s, k)
 
     def one(args):
-        pts, ids, cd2, cidx = args            # [S,3],[S],[S,k],[S,k]
-        dx = pts[:, None, 0] - pts[None, :, 0]
-        dy = pts[:, None, 1] - pts[None, :, 1]
-        dz = pts[:, None, 2] - pts[None, :, 2]
-        d2 = (dx * dx + dy * dy) + dz * dz    # [S, S]
+        pts, ids, cd2, cidx = args            # [S,D],[S],[S,k],[S,k]
+        d2 = elementwise_dist2(pts, pts)      # [S, S]
         # pad lanes: PAD_SENTINEL coords already overflow to +inf, the
         # mask makes it explicit (and safe against sentinel changes)
         d2 = jnp.where(ids[None, :] >= 0, d2, jnp.inf)
@@ -135,7 +144,9 @@ def knn_update_tiled(state: CandidateState, q: BucketedPoints,
                      p: BucketedPoints, *, chunk_buckets: int | None = None,
                      visits_per_step: int = 8, with_stats: bool | str = False,
                      skip_self=None, self_group: int = 1,
-                     canonical_ties: bool = False):
+                     canonical_ties: bool = False,
+                     score_dtype: str = "f32",
+                     point_norms2=None):
     """Fold every real point of ``p`` into the candidate state (one
     reference ``runQuery`` launch, at bucket granularity).
 
@@ -144,6 +155,20 @@ def knn_update_tiled(state: CandidateState, q: BucketedPoints,
     ``with_stats`` also an i32 count of [S, T] distance tiles actually
     computed (chunks skipped by the all-pruned ``lax.cond`` don't count),
     from which callers derive executed distance evaluations / FLOPs.
+    ``with_stats="full"`` additionally returns the i32 count of chunk FOLDS
+    executed — the number of ``merge_candidates`` sort-merges that actually
+    ran (skipped chunks don't merge), the twin's analogue of the Pallas
+    kernel's fold-pass counter.
+
+    ``score_dtype``: ``"f32"`` (default) scores every tile with the exact
+    elementwise VPU form; ``"bf16"`` scores with the matmul-form MXU
+    expansion (ops/distance.py) — one bf16 dot_general per tile, f32
+    accumulation — then rescores the top ``rescore_width(k)`` survivors
+    per row with the exact f32 form before the merge, so the values
+    reaching the candidate state are never approximate. ``point_norms2``
+    optionally carries precomputed ``||p||^2`` per resident lane
+    (f32[Bp, T] — the serving engine computes it once at index upload);
+    ignored under f32.
 
     Each ``while_loop`` step visits ``visits_per_step`` point buckets per
     query bucket at once: one [C, S, V*T] distance tile and ONE width-2k
@@ -173,8 +198,11 @@ def knn_update_tiled(state: CandidateState, q: BucketedPoints,
     bucket is safely skippable — a tie never displaces — which is why the
     default keeps ``<``: identical results, strictly fewer visits.)
     """
+    validate_score_dtype(score_dtype)
     num_qb, s_q = q.ids.shape
     num_pb, s_p = p.ids.shape
+    dim = q.pts.shape[-1]
+    use_mxu = score_dtype == "bf16" and dim >= mxu_min_dim()
     k = state.dist2.shape[-1]
 
     v = max(1, min(visits_per_step, num_pb))
@@ -204,7 +232,13 @@ def knn_update_tiled(state: CandidateState, q: BucketedPoints,
     hd2 = state.dist2.reshape(num_qb, s_q, k)
     hidx = state.idx.reshape(num_qb, s_q, k)
 
-    q_chunked = q.pts.reshape(n_chunks, chunk, s_q, 3)
+    q_chunked = q.pts.reshape(n_chunks, chunk, s_q, dim)
+    if use_mxu:
+        # per-lane ||p||^2, exact f32 — precomputed once at upload by the
+        # serving engine, derived here otherwise (pad lanes overflow to
+        # +inf, so they can never win the survivor top_k)
+        pn2_all = (jnp.asarray(point_norms2, jnp.float32)
+                   if point_norms2 is not None else norms2(p.pts))  # [Bp,T]
 
     def live(box_d2, radius2):
         # canonical mode must VISIT buckets tied exactly at the prune radius
@@ -214,13 +248,13 @@ def knn_update_tiled(state: CandidateState, q: BucketedPoints,
         return box_d2 <= radius2 if canonical_ties else box_d2 < radius2
 
     def cond(carry):
-        _hd2, _hidx, worst2, step, _tiles = carry
+        _hd2, _hidx, worst2, step, _tiles, _folds = carry
         next_d2 = lax.dynamic_index_in_dim(sorted_d2, jnp.minimum(
             step * v, num_pb - 1), axis=1, keepdims=False)
         return (step < n_steps) & jnp.any(live(next_d2, worst2))
 
     def body(carry):
-        hd2, hidx, worst2, step, tiles = carry
+        hd2, hidx, worst2, step, tiles, folds = carry
         visit = lax.dynamic_slice_in_dim(order, step * v, v, axis=1)
         visit_d2 = lax.dynamic_slice_in_dim(sorted_d2, step * v, v, axis=1)
         active = live(visit_d2, worst2[:, None])                 # [Bq, V]
@@ -228,30 +262,39 @@ def knn_update_tiled(state: CandidateState, q: BucketedPoints,
             own = (jnp.arange(num_qb, dtype=visit.dtype)
                    // self_group)[:, None]
             active &= ~((visit == own) & (jnp.asarray(skip_self) != 0))
-        pts_v = p.pts[visit]                                     # [Bq,V,T,3]
+        pts_v = p.pts[visit]                                     # [Bq,V,T,D]
         ids_v = p.ids[visit]                                     # [Bq,V,T]
+        ops = [q_chunked,
+               pts_v.reshape(n_chunks, chunk, v, s_p, dim),
+               ids_v.reshape(n_chunks, chunk, v, s_p),
+               active.reshape(n_chunks, chunk, v),
+               hd2.reshape(n_chunks, chunk, s_q, k),
+               hidx.reshape(n_chunks, chunk, s_q, k)]
+        if use_mxu:
+            ops.append(pn2_all[visit].reshape(n_chunks, chunk, v, s_p))
 
         def chunk_fn(args):
-            qp, pp, pid, act, cd2, cidx = args
+            qp, pp, pid, act, cd2, cidx = args[:6]
+            pn2c = args[6] if use_mxu else None
 
             def compute(_):
-                # [C, S, V*T] distance tile against the V gathered buckets
-                ppf = pp.reshape(chunk, v * s_p, 3)
-                dx = qp[:, :, None, 0] - ppf[:, None, :, 0]
-                dy = qp[:, :, None, 1] - ppf[:, None, :, 1]
-                dz = qp[:, :, None, 2] - ppf[:, None, :, 2]
-                d2 = (dx * dx + dy * dy) + dz * dz
-                mask = jnp.broadcast_to(act[:, None, :, None],
-                                        (chunk, 1, v, s_p))
-                d2 = jnp.where(mask.reshape(chunk, 1, v * s_p), d2, jnp.inf)
+                # [C, S, V*T] score tile against the V gathered buckets —
+                # exact elementwise (VPU) or matmul-form bf16 score + exact
+                # f32 rescore of the survivors (MXU), ops/distance.py
+                ppf = pp.reshape(chunk, v * s_p, dim)
+                mask = jnp.broadcast_to(
+                    act[:, None, :, None],
+                    (chunk, 1, v, s_p)).reshape(chunk, 1, v * s_p)
+                d2, ids = score_tile(
+                    qp, ppf, pid.reshape(chunk, v * s_p), k,
+                    score_dtype=score_dtype, mask=mask,
+                    pn2=pn2c.reshape(chunk, v * s_p) if use_mxu else None)
+                w = d2.shape[-1]
                 st = merge_candidates(
                     CandidateState(cd2.reshape(chunk * s_q, k),
                                    cidx.reshape(chunk * s_q, k)),
-                    d2.reshape(chunk * s_q, v * s_p),
-                    jnp.broadcast_to(
-                        pid.reshape(chunk, 1, v * s_p),
-                        (chunk, s_q, v * s_p)).reshape(
-                            chunk * s_q, v * s_p),
+                    d2.reshape(chunk * s_q, w),
+                    ids.reshape(chunk * s_q, w),
                     canonical=canonical_ties)
                 return (st.dist2.reshape(chunk, s_q, k),
                         st.idx.reshape(chunk, s_q, k))
@@ -263,23 +306,19 @@ def knn_update_tiled(state: CandidateState, q: BucketedPoints,
             return lax.cond(jnp.any(act), compute,
                             lambda _: (cd2, cidx), None)
 
-        hd2, hidx = lax.map(chunk_fn, (
-            q_chunked,
-            pts_v.reshape(n_chunks, chunk, v, s_p, 3),
-            ids_v.reshape(n_chunks, chunk, v, s_p),
-            active.reshape(n_chunks, chunk, v),
-            hd2.reshape(n_chunks, chunk, s_q, k),
-            hidx.reshape(n_chunks, chunk, s_q, k)))
+        hd2, hidx = lax.map(chunk_fn, tuple(ops))
         hd2 = hd2.reshape(num_qb, s_q, k)
         hidx = hidx.reshape(num_qb, s_q, k)
         # tiles executed this step: skipped chunks contribute 0, a computed
         # chunk contributes its full chunk*V tiles (masked-out buckets in
         # an active chunk still burn VPU work — count what ran, not what
-        # was useful)
+        # was useful); folds counts the chunk merges that actually ran
         act_c = active.reshape(n_chunks, chunk * v)
+        ran = jnp.any(act_c, axis=1)
         tiles = tiles + jnp.sum(
-            jnp.where(jnp.any(act_c, axis=1), chunk * v, 0)).astype(jnp.int32)
-        return hd2, hidx, _worst2(hd2, qvalid), step + 1, tiles
+            jnp.where(ran, chunk * v, 0)).astype(jnp.int32)
+        folds = folds + jnp.sum(ran).astype(jnp.int32)
+        return hd2, hidx, _worst2(hd2, qvalid), step + 1, tiles, folds
 
     # derive the zero from the heap so the counter carries the same
     # varying-manual-axes type as the rest of the carry under shard_map
@@ -287,11 +326,13 @@ def knn_update_tiled(state: CandidateState, q: BucketedPoints,
     # a comparison, not a multiply: hd2 starts at cutoff^2 = inf by default
     # and inf * 0 is NaN, whose int cast is backend-defined
     tiles0 = (hd2[0, 0, 0] < 0).astype(jnp.int32)
-    init = (hd2, hidx, _worst2(hd2, qvalid), jnp.int32(0), tiles0)
-    hd2, hidx, _, _, tiles = lax.while_loop(cond, body, init)
+    init = (hd2, hidx, _worst2(hd2, qvalid), jnp.int32(0), tiles0, tiles0)
+    hd2, hidx, _, _, tiles, folds = lax.while_loop(cond, body, init)
     out = CandidateState(hd2.reshape(num_qb * s_q, k),
                          hidx.reshape(num_qb * s_q, k))
     if with_stats == "full":
-        # width-2k sort-merge, not extract-min: no pass counter exists
-        return out, tiles, tiles0 * 0
+        # folds = chunk sort-merges actually executed (a REAL counter, the
+        # twin's analogue of the Pallas fold-pass count — one width-2k
+        # merge per non-pruned chunk per step)
+        return out, tiles, folds
     return (out, tiles) if with_stats else out

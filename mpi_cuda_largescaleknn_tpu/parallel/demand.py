@@ -634,7 +634,8 @@ def demand_knn_chunked(points_sharded: jnp.ndarray,
     final = smap(lambda c, h: _trim_rows(*final_fn(c, h), chunk_rows), 2,
                  (spec, spec, spec))
 
-    pts_g = points_sharded.reshape(num_shards, npad, 3)
+    dim = int(points_sharded.shape[-1])
+    pts_g = points_sharded.reshape(num_shards, npad, dim)
     ids_g = ids_sharded.reshape(num_shards, npad)
     out_d = np.full((num_shards, npad), np.inf, np.float32)
     # candidate arrays are N*k*12 bytes — the exact memory wall this
@@ -668,12 +669,12 @@ def demand_knn_chunked(points_sharded: jnp.ndarray,
     for c in range(start_chunk, n_chunks):
         lo = c * chunk_rows
         hi = min(lo + chunk_rows, npad)
-        qp = np.full((num_shards, chunk_rows, 3), _PS, np.float32)
+        qp = np.full((num_shards, chunk_rows, dim), _PS, np.float32)
         qi = np.full((num_shards, chunk_rows), -1, np.int32)
         qp[:, :hi - lo] = pts_g[:, lo:hi]
         qi[:, :hi - lo] = ids_g[:, lo:hi]
         ctx, heap = qinit(
-            jax.device_put(qp.reshape(-1, 3), sharding),
+            jax.device_put(qp.reshape(-1, dim), sharding),
             jax.device_put(qi.reshape(-1), sharding), all_lo, all_hi)
         # pristine pair each chunk: the resident original never rotates
         f_state, b_state = shard0, shard0

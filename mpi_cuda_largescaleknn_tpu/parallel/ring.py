@@ -73,8 +73,8 @@ from mpi_cuda_largescaleknn_tpu.utils.math import next_pow2
 
 
 @lru_cache(maxsize=32)  # bounded: chunked drivers with varying chunk shapes
-def _partition_smaps(mesh, num_buckets, bucket_size):  # or fresh Mesh objects
-    # must not pin compiled programs + device refs forever
+def _partition_smaps(mesh, num_buckets, bucket_size, dim):  # or fresh Mesh
+    # objects must not pin compiled programs + device refs forever
     spec = P(AXIS)
 
     def smap(fn, in_specs, out_specs):
@@ -84,11 +84,12 @@ def _partition_smaps(mesh, num_buckets, bucket_size):  # or fresh Mesh objects
                                      out_specs=out_specs))
 
     kw = dict(num_buckets=num_buckets, bucket_size=bucket_size)
-    prep = smap(partial(partition_prep, **kw), (spec, spec), (spec,) * 5)
+    ncols = dim + 2  # D coordinate columns + ids + pos
+    prep = smap(partial(partition_prep, **kw), (spec, spec), (spec,) * ncols)
     # num_seg rides replicated so every level reuses the ONE compiled sort
-    level = smap(partial(_partition_level, **kw), (spec,) * 5 + (P(),),
-                 (spec,) * 5)
-    fin = smap(partial(partition_finalize, **kw), (spec,) * 5, spec)
+    level = smap(partial(_partition_level, **kw), (spec,) * ncols + (P(),),
+                 (spec,) * ncols)
+    fin = smap(partial(partition_finalize, **kw), (spec,) * ncols, spec)
     return prep, level, fin
 
 
@@ -106,7 +107,8 @@ def partition_sharded(points_sharded, ids_sharded, mesh,
     num_shards = mesh.shape[AXIS]
     npad_local = points_sharded.shape[0] // num_shards
     b, s = choose_buckets(npad_local, bucket_size)
-    prep, level, fin = _partition_smaps(mesh, b, s)
+    prep, level, fin = _partition_smaps(mesh, b, s,
+                                        int(points_sharded.shape[-1]))
 
     sharding = NamedSharding(mesh, P(AXIS))
     pts = jax.device_put(points_sharded, sharding)
@@ -117,13 +119,18 @@ def partition_sharded(points_sharded, ids_sharded, mesh,
     return fin(*cols)
 
 
-def _engine_fn(engine: str, query_tile: int, point_tile: int):
+def _engine_fn(engine: str, query_tile: int, point_tile: int,
+               score_dtype: str = "f32"):
     # flat-engine dispatch only; "auto"/"tiled"/"pallas_tiled" take the
     # bucketed data path (_make_ring_fns tiled branch, the q/shard_state
     # branch in demand_knn) before this
     if engine == "bruteforce":
         return partial(knn_update_bruteforce, query_tile=query_tile,
-                       point_tile=point_tile)
+                       point_tile=point_tile, score_dtype=score_dtype)
+    if score_dtype != "f32":
+        raise ValueError(
+            f"engine '{engine}' has no score_dtype='{score_dtype}' path "
+            "(MXU scoring exists for bruteforce and the tiled engines)")
     if engine == "tree":
         return knn_update_tree
     if engine == "pallas":
@@ -342,7 +349,8 @@ def _tiled_engine_fn(engine: str):
 
 
 def _make_ring_fns(k, max_radius, engine, query_tile, point_tile, bucket_size,
-                   num_shards, warm_start=False, point_group=1):
+                   num_shards, warm_start=False, point_group=1,
+                   score_dtype="f32"):
     """(init_fn, round_fn, final_fn, shard_init_fn, query_init_fn) — the
     per-round pieces every ring driver executes, defined once so the fused,
     stepwise and chunked paths cannot diverge.
@@ -430,7 +438,8 @@ def _make_ring_fns(k, max_radius, engine, query_tile, point_tile, bucket_size,
             resident = BucketedPoints(shard[0], shard[1], shard[2], shard[3],
                                       shard[1])
             return tiled_update(heap, q, resident, with_stats=True,
-                                skip_self=sskip, self_group=point_group)
+                                skip_self=sskip, self_group=point_group,
+                                score_dtype=score_dtype)
 
         def round_fn(q, shard_pair, heap, rnd, rotate=True):
             # the final round's rotation would be discarded — callers pass
@@ -470,7 +479,7 @@ def _make_ring_fns(k, max_radius, engine, query_tile, point_tile, bucket_size,
         # *_from_q form — no tiled init_fn/shard_init_fn/query_init_fn
         init_fn = shard_init_fn = query_init_fn = None
     else:
-        update = _engine_fn(engine, query_tile, point_tile)
+        update = _engine_fn(engine, query_tile, point_tile, score_dtype)
         use_tree = engine == "tree"
 
         def query_init_fn(qpts_local, qids_local):
@@ -612,6 +621,7 @@ def ring_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
              mesh, *, max_radius: float = jnp.inf, engine: str = "auto",
              query_tile: int = 2048, point_tile: int = 2048,
              bucket_size: int = 0, point_group: int = 0,
+             score_dtype: str = "f32",
              return_candidates: bool = False,
              return_stats: bool = False):
     """Run the full R-round ring on a 1-D mesh (fused ``lax.fori_loop``).
@@ -639,7 +649,7 @@ def ring_knn(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray, k: int,
     init_fn, round_fn, final_fn, _sif, _qif, init_from_q, _qfq = \
         _make_ring_fns(k, max_radius, engine, query_tile, point_tile,
                        bucket_size, num_shards, warm_start=True,
-                       point_group=point_group)
+                       point_group=point_group, score_dtype=score_dtype)
 
     def body(pts_local, ids_local, q_local=None):
         if q_local is not None:
@@ -710,7 +720,7 @@ def ring_knn_stepwise(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
                       k: int, mesh, *, max_radius: float = jnp.inf,
                       engine: str = "auto", query_tile: int = 2048,
                       point_tile: int = 2048, bucket_size: int = 0,
-                      point_group: int = 0,
+                      point_group: int = 0, score_dtype: str = "f32",
                       checkpoint_dir: str | None = None,
                       checkpoint_every: int = 1,
                       max_rounds: int | None = None,
@@ -766,6 +776,9 @@ def ring_knn_stepwise(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
             # checkpoints need the explicit flags resolve_bucket_size's
             # docstring names.
             **({"point_group": point_group} if point_group > 1 else {}),
+            # key present only for non-default scoring: f32 checkpoints
+            # written before the knob existed stay resumable
+            **({"score_dtype": score_dtype} if score_dtype != "f32" else {}),
             query_tile=query_tile, point_tile=point_tile, ring="bidir",
             data=ckpt.data_digest(points_sharded, ids_sharded))
         # decide resume BEFORE init: a resumed run's heap comes from the
@@ -776,7 +789,7 @@ def ring_knn_stepwise(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
     init_fn, round_fn, final_fn, _sif, _qif, init_from_q, _qfq = \
         _make_ring_fns(k, max_radius, engine, query_tile, point_tile,
                        bucket_size, num_shards, warm_start=not resuming,
-                       point_group=point_group)
+                       point_group=point_group, score_dtype=score_dtype)
 
     if init_from_q is not None:
         q_parts = partition_sharded(pts, ids, mesh, bucket_size)
@@ -851,6 +864,7 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
                      max_radius: float = jnp.inf, engine: str = "auto",
                      query_tile: int = 2048, point_tile: int = 2048,
                      bucket_size: int = 0, point_group: int = 0,
+                     score_dtype: str = "f32",
                      checkpoint_dir: str | None = None,
                      checkpoint_every: int = 1,
                      max_chunks: int | None = None,
@@ -928,7 +942,8 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
     _init, round_fn, final_fn, shard_init_fn, query_init_fn, _ifq, \
         query_from_q = _make_ring_fns(
             k, max_radius, engine, query_tile, point_tile, bucket_size,
-            num_shards)
+            num_shards, score_dtype=score_dtype)
+    dim = int(points_sharded.shape[-1])
     spec = P(AXIS)
     check_vma = not engine.startswith("pallas")
     sharding = NamedSharding(mesh, spec)
@@ -952,7 +967,7 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
                 out[pos] = np.asarray(sh.data).reshape((npad_local,) + width)
             return out
 
-        pts_b = blocks(pts_glob, (3,))
+        pts_b = blocks(pts_glob, (dim,))
         ids_b = blocks(ids_glob, ())
     else:
         points_sharded = np.asarray(points_sharded, np.float32)
@@ -960,7 +975,7 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
         npad_local = points_sharded.shape[0] // num_shards
         pts_glob = jax.device_put(points_sharded, sharding)
         ids_glob = jax.device_put(ids_sharded, sharding)
-        pts_g3 = points_sharded.reshape(num_shards, npad_local, 3)
+        pts_g3 = points_sharded.reshape(num_shards, npad_local, dim)
         ids_g2 = ids_sharded.reshape(num_shards, npad_local)
         pts_b = {s: pts_g3[s] for s in range(num_shards)}
         ids_b = {s: ids_g2[s] for s in range(num_shards)}
@@ -1037,7 +1052,8 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
         # whatever axis the mesh spans (ICI or DCN)
         qrows = num_shards * chunk_rows
         flat_update = (None if use_tiled
-                       else _engine_fn(engine, query_tile, point_tile))
+                       else _engine_fn(engine, query_tile, point_tile,
+                                       score_dtype))
         tiled_update_m = _tiled_engine_fn(engine) if use_tiled else None
 
         def merge_body(*args):
@@ -1055,7 +1071,8 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
                 resident = BucketedPoints(shard[0], shard[1], shard[2],
                                           shard[3], shard[1])
                 st, tiles = tiled_update_m(heap, qb, resident,
-                                           with_stats=True)
+                                           with_stats=True,
+                                           score_dtype=score_dtype)
             else:
                 st = flat_update(heap, q, *shard)
                 tiles = pvary(jnp.zeros((), jnp.int32))
@@ -1094,6 +1111,7 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
             # written before the knob existed stay resumable (results are
             # bit-identical across modes, but resuming records the plan)
             **({"merge": merge} if merge == "device" else {}),
+            **({"score_dtype": score_dtype} if score_dtype != "f32" else {}),
             my_pos=",".join(str(s) for s in my_pos),
             data=ckpt.data_digest(
                 np.concatenate([pts_b[s].reshape(-1) for s in my_pos]),
@@ -1120,7 +1138,7 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
         # staging chunk c+1 overlaps chunk c's in-flight work
         lo = c * chunk_rows
         hi = min(lo + chunk_rows, npad_local)
-        qp = np.full((n_my, chunk_rows, 3), PAD_SENTINEL, np.float32)
+        qp = np.full((n_my, chunk_rows, dim), PAD_SENTINEL, np.float32)
         qi = np.full((n_my, chunk_rows), -1, np.int32)
         for j, s in enumerate(my_pos):
             qp[j, :hi - lo] = pts_b[s][lo:hi]
@@ -1129,10 +1147,10 @@ def ring_knn_chunked(points_sharded: jnp.ndarray, ids_sharded: jnp.ndarray,
             # ids stay host-side: result neighbor ids come from the
             # resident shard, and validity rides the sentinel coordinates;
             # each host uploads only ITS rows — the program all_gathers
-            return lo, hi, to_global(qp.reshape(-1, 3),
+            return lo, hi, to_global(qp.reshape(-1, dim),
                                      num_shards * chunk_rows), None
         stationary, heap = qinit(
-            to_global(qp.reshape(-1, 3), num_shards * chunk_rows),
+            to_global(qp.reshape(-1, dim), num_shards * chunk_rows),
             to_global(qi.reshape(-1), num_shards * chunk_rows))
         return lo, hi, stationary, heap
 

@@ -72,12 +72,19 @@ class DynamicBatcher:
 
     def __init__(self, query_fn, *, max_batch: int,
                  max_delay_s: float = 0.002, timers=None,
-                 pipeline_depth: int = 1, min_batch: int | None = None):
+                 pipeline_depth: int = 1, min_batch: int | None = None,
+                 dim: int | None = None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         if pipeline_depth < 1:
             raise ValueError("pipeline_depth must be >= 1")
         self._query_fn = query_fn
+        #: point dimensionality for normalizing flat submit() inputs;
+        #: taken from the query_fn's engine/fanout when not given (3 as
+        #: the last-resort legacy default)
+        self.dim = int(dim) if dim else int(getattr(
+            getattr(query_fn, "engine", None), "dim", 0)
+            or getattr(query_fn, "dim", 0) or 3)
         self.max_batch = int(max_batch)
         #: stall-aware flush floor: while the device pipeline is BUSY (but
         #: not full), a deadline flush is worth dispatching only for at
@@ -133,7 +140,9 @@ class DynamicBatcher:
     def submit(self, queries: np.ndarray, timeout_s: float | None = None):
         """Block until the batch containing ``queries`` executes; returns
         ``(dists, neighbors)`` or raises the request's error."""
-        queries = np.asarray(queries, np.float32).reshape(-1, 3)
+        # normalize to [n, dim] rows (flat inputs carry n*dim floats — the
+        # legacy direct-caller contract, now D-generic via self.dim)
+        queries = np.asarray(queries, np.float32).reshape(-1, self.dim)
         now = time.monotonic()
         req = _Request(queries=queries, enqueued=now,
                        deadline=(now + timeout_s) if timeout_s else None)

@@ -138,9 +138,13 @@ class ResidentKnnEngine:
                  engine: str = "auto", bucket_size: int = 0,
                  max_radius: float = math.inf, max_batch: int = 1024,
                  min_batch: int = 8, merge: str = "auto",
-                 query_buckets: int = 0):
+                 query_buckets: int = 0, score_dtype: str = "f32"):
         import jax
 
+        from mpi_cuda_largescaleknn_tpu.ops.distance import (
+            mxu_min_dim,
+            validate_score_dtype,
+        )
         from mpi_cuda_largescaleknn_tpu.parallel.mesh import AXIS, get_mesh
         from mpi_cuda_largescaleknn_tpu.parallel.ring import (
             resolve_bucket_size,
@@ -150,8 +154,8 @@ class ResidentKnnEngine:
         )
 
         points = np.asarray(points, np.float32)
-        if points.ndim != 2 or points.shape[1] != 3:
-            raise ValueError(f"points must be [N, 3], got {points.shape}")
+        if points.ndim != 2 or points.shape[1] < 1:
+            raise ValueError(f"points must be [N, D], got {points.shape}")
         if k < 1:
             raise ValueError("k must be >= 1")
         min_batch = max(8, next_pow2(min_batch))
@@ -159,12 +163,28 @@ class ResidentKnnEngine:
 
         self.k = int(k)
         self.n_points = len(points)
+        #: point dimensionality — the whole ops/io/serve stack is D-generic
+        #: (the matmul-form scorer is what makes high D affordable); only
+        #: the Morton admission sort is 3-D-specific and disables itself
+        self.dim = int(points.shape[1])
         self.max_radius = float(max_radius)
         self.mesh = mesh if mesh is not None else get_mesh(None)
         self.num_shards = self.mesh.shape[AXIS]
         self.engine_name = resolve_engine(engine)
         self.bucket_size = resolve_bucket_size(bucket_size, self.engine_name)
         self.merge_mode = resolve_merge(merge, self.num_shards)
+        #: distance scoring mode, part of every AOT bucket key: "f32" =
+        #: exact elementwise (VPU), "bf16" = matmul-form MXU score + exact
+        #: f32 rescore (ops/distance.py). A mid-stream Pallas degradation
+        #: keeps the mode — the XLA twin takes the same knob.
+        #: ``score_mode`` is the EFFECTIVE path: below ``mxu_min_dim()``
+        #: a bf16 request still scores exactly on the VPU (the matmul form
+        #: cannot win there), and the per-mode tile counters follow the
+        #: path that actually runs
+        self.score_dtype = validate_score_dtype(score_dtype)
+        self.score_mode = ("mxu" if (self.score_dtype == "bf16"
+                                     and self.dim >= mxu_min_dim())
+                           else "vpu")
         #: pod mode: the mesh spans processes — every host runs ONE engine
         #: over the same global mesh, dispatches IDENTICAL batches in the
         #: same order (the front end's contract), and fetches only its
@@ -211,14 +231,17 @@ class ResidentKnnEngine:
         self.query_buckets_setting = int(query_buckets)
         self.query_buckets = {
             q: (resolve_query_buckets(query_buckets, q, self.k)
-                if use_tiled else 1)
+                if use_tiled and self.dim == 3 else 1)
             for q in self.shape_buckets}
         #: Morton admission: sort every dispatched batch by Z-order code
         #: over the index bbox (pads last), un-permuted at complete().
         #: Off when the caller pinned query_buckets=1 — that configuration
         #: IS the unsorted baseline the exactness tests and the locality
-        #: bench compare against.
-        self.sort_queries = use_tiled and self.query_buckets_setting != 1
+        #: bench compare against. The Morton encoder is 3-D (utils/math.py),
+        #: so non-3-D indexes serve single-bucket unsorted batches — still
+        #: exact, just without the locality prune.
+        self.sort_queries = (use_tiled and self.query_buckets_setting != 1
+                             and self.dim == 3)
         #: canonical (dist2, id) tie order inside the traversal — what
         #: makes results bit-identical across query bucket geometries. The
         #: boundary tie-fix routes ids through a f32 top_k (exact below
@@ -262,8 +285,10 @@ class ResidentKnnEngine:
 
         # index bounding box: the Morton admission sort's quantization
         # frame (queries outside it clamp to the faces — still ordered)
-        self._index_lo = points.min(axis=0) if len(points) else np.zeros(3)
-        self._index_hi = points.max(axis=0) if len(points) else np.ones(3)
+        self._index_lo = (points.min(axis=0) if len(points)
+                          else np.zeros(self.dim))
+        self._index_hi = (points.max(axis=0) if len(points)
+                          else np.ones(self.dim))
         bounds = slab_bounds(len(points), self.num_shards)
         sharding = NamedSharding(self.mesh, P(AXIS))
         if self._multi:
@@ -275,10 +300,11 @@ class ResidentKnnEngine:
             my_pos = self._my_pos = my_mesh_positions(self.mesh)
             local_flat, local_ids, _counts, self.npad_local = pad_and_flatten(
                 [points[bounds[s][0]:bounds[s][1]] for s in my_pos],
-                id_bases=[bounds[s][0] for s in my_pos], pad_to=npad)
+                id_bases=[bounds[s][0] for s in my_pos], pad_to=npad,
+                dim=self.dim)
             rows = self.num_shards * npad
             flat = jax.make_array_from_process_local_data(
-                sharding, local_flat, (rows, 3))
+                sharding, local_flat, (rows, self.dim))
             ids = jax.make_array_from_process_local_data(
                 sharding, local_ids, (rows,))
             self._flat_pts, self._flat_ids = flat, ids
@@ -286,7 +312,7 @@ class ResidentKnnEngine:
             self._my_pos = list(range(self.num_shards))
             shards = [points[b:e] for b, e in bounds]
             flat, ids, _counts, self.npad_local = pad_and_flatten(
-                shards, id_bases=[b for b, _ in bounds])
+                shards, id_bases=[b for b, _ in bounds], dim=self.dim)
             # the flat resident side serves the bruteforce engine; the
             # bucketed one serves the tiled engines — both stay
             # device-resident for the life of the process (the reference
@@ -295,6 +321,17 @@ class ResidentKnnEngine:
             self._flat_ids = jax.device_put(ids, sharding)
         self._buckets = partition_sharded(self._flat_pts, self._flat_ids,
                                           self.mesh, self.bucket_size)
+        #: per-bucket ||p||^2, computed ONCE at upload and resident beside
+        #: the buckets — the matmul expansion's precomputed norm term
+        #: (ops/distance.py). Only materialized when the MXU score is on.
+        self._bucket_norms2 = None
+        if self.score_mode == "mxu" and self.engine_name in (
+                "tiled", "pallas_tiled"):
+            from mpi_cuda_largescaleknn_tpu.ops.distance import norms2
+
+            # jit keeps the buckets' dim-0 sharding (elementwise reduce
+            # over the component axis), single- and multi-host alike
+            self._bucket_norms2 = jax.jit(norms2)(self._buckets.pts)
         self._replicated = NamedSharding(self.mesh, P())
 
     def _stage_replicated(self, q: np.ndarray):
@@ -339,6 +376,9 @@ class ResidentKnnEngine:
         num_shards = self.num_shards
         device_merge = self.merge_mode == "device"
         canonical = self.canonical_ties
+        dim = self.dim
+        score_dtype = self.score_dtype
+        use_mxu = self.score_mode == "mxu"
 
         def finish(st, tiles):
             # per-shard local top-k -> program output. Host merge: emit the
@@ -358,7 +398,13 @@ class ResidentKnnEngine:
             tiled_update = _tiled_engine_fn(engine_name)
             s_q = qpad // qbuckets
 
-            def body(bpts, bids, blo, bhi, q):
+            def body(*args):
+                if use_mxu:
+                    # the precomputed per-bucket ||p||^2 rides as an extra
+                    # resident operand (computed once at upload)
+                    bpts, bids, blo, bhi, bn2, q = args
+                else:
+                    (bpts, bids, blo, bhi, q), bn2 = args, None
                 # q f32[qpad,3] is REPLICATED: every device traverses its own
                 # resident shard for the same queries; its local top-k is
                 # exact over that shard, and the merge of the R partial
@@ -373,7 +419,7 @@ class ResidentKnnEngine:
                 # their -inf radius never keeps the traversal alive.
                 valid = q[:, 0] < PAD_SENTINEL / 2
                 qids = jnp.where(valid, jnp.arange(qpad, dtype=jnp.int32), -1)
-                qg = q.reshape(qbuckets, s_q, 3)
+                qg = q.reshape(qbuckets, s_q, dim)
                 vg = valid.reshape(qbuckets, s_q, 1)
                 lo = jnp.min(jnp.where(vg, qg, jnp.inf), axis=1)
                 hi = jnp.max(jnp.where(vg, qg, -jnp.inf), axis=1)
@@ -381,7 +427,8 @@ class ResidentKnnEngine:
                                     qids.reshape(qbuckets, s_q))
                 heap = pvary(init_candidates(qpad, k, max_radius))
                 resident = BucketedPoints(bpts, bids, blo, bhi, bids)
-                kw = dict(with_stats=True, canonical_ties=canonical)
+                kw = dict(with_stats=True, canonical_ties=canonical,
+                          score_dtype=score_dtype, point_norms2=bn2)
                 if engine_name == "tiled":
                     # chunk = ONE query bucket: the lax.map cond skips at
                     # per-bucket granularity, so a finished bucket stops
@@ -396,12 +443,13 @@ class ResidentKnnEngine:
                 # makes executed/possible comparable across bucketings
                 return finish(st, jnp.reshape(tiles * s_q, (1,)))
 
-            in_specs = (P(AXIS),) * 4 + (P(),)
+            in_specs = (P(AXIS),) * (5 if use_mxu else 4) + (P(),)
         else:
 
             def body(spts, sids, q):
                 heap = pvary(init_candidates(qpad, k, max_radius))
-                st = knn_update_bruteforce(heap, q, spts, sids)
+                st = knn_update_bruteforce(heap, q, spts, sids,
+                                           score_dtype=score_dtype)
                 # flat engines score every pair; no tiles to count
                 return finish(st, pvary(jnp.zeros((1,), jnp.int32)))
 
@@ -423,7 +471,10 @@ class ResidentKnnEngine:
     def _resident_args(self, engine_name: str):
         if engine_name in ("tiled", "pallas_tiled"):
             b = self._buckets
-            return (b.pts, b.ids, b.lower, b.upper)
+            base = (b.pts, b.ids, b.lower, b.upper)
+            if self.score_mode == "mxu":
+                return base + (self._bucket_norms2,)
+            return base
         return (self._flat_pts, self._flat_ids)
 
     def _tiles_possible(self, engine_name: str, qpad: int) -> int:
@@ -455,21 +506,21 @@ class ResidentKnnEngine:
         recompile-freedom contract the tests assert. A compiled executable
         rejects any other input shape instead of silently retracing.
         Device-merge programs are distinct HLO from host-merge ones, and so
-        are different query bucketings, so both are part of the bucket
-        key — the recompile-freedom discipline holds per
-        (engine, merge, shape, query_buckets) tuple.
+        are different query bucketings and score dtypes, so all are part of
+        the bucket key — the recompile-freedom discipline holds per
+        (engine, merge, shape, query_buckets, score_dtype) tuple.
         """
         import jax
 
         qb = self.query_buckets[qpad]
-        key = (self.engine_name, self.merge_mode, qpad, qb)
+        key = (self.engine_name, self.merge_mode, qpad, qb, self.score_dtype)
         exe = self._executables.get(key)
         if exe is not None:
             return exe
         with self.timers.phase(f"compile_q{qpad}"):
             fn = self._build_query_fn(self.engine_name, qpad, qb)
             q0 = self._stage_replicated(
-                np.full((qpad, 3), PAD_SENTINEL, np.float32))
+                np.full((qpad, self.dim), PAD_SENTINEL, np.float32))
             exe = fn.lower(*self._resident_args(self.engine_name),
                            q0).compile()
             self.compile_count += 1
@@ -496,7 +547,7 @@ class ResidentKnnEngine:
                 # run once on an all-padding batch: pays any lazy backend
                 # init; the traversal early-exits (no real queries)
                 q0 = self._stage_replicated(
-                    np.full((qpad, 3), PAD_SENTINEL, np.float32))
+                    np.full((qpad, self.dim), PAD_SENTINEL, np.float32))
                 out = exe(*self._resident_args(self.engine_name), q0)
                 jax.block_until_ready(out)
                 self._count_tiles(self._tiles_fetch(out[2]),
@@ -510,11 +561,17 @@ class ResidentKnnEngine:
 
     def _count_tiles(self, executed: int, possible: int) -> None:
         """Fold one batch's measured tile count into the cumulative
-        executed/skipped counters (flat engines report 0/0)."""
+        executed/skipped counters (flat engines report 0/0). Counted twice:
+        the aggregate (the stable /stats surface) and the per-score-mode
+        twin (``tiles_executed_mxu`` vs ``tiles_executed_vpu``), so the
+        MXU-vs-VPU attribution is a number on /stats and /metrics."""
         if possible <= 0 and executed <= 0:
             return
         self.timers.count("tiles_executed", executed)
         self.timers.count("tiles_skipped", max(0, possible - executed))
+        self.timers.count(f"tiles_executed_{self.score_mode}", executed)
+        self.timers.count(f"tiles_skipped_{self.score_mode}",
+                          max(0, possible - executed))
 
     def _tiles_fetch(self, t) -> int:
         """Sum a program's per-shard tile counts. Pod mode: only this
@@ -601,7 +658,7 @@ class ResidentKnnEngine:
 
         from mpi_cuda_largescaleknn_tpu.utils.math import morton_argsort
 
-        queries = np.asarray(queries, np.float32).reshape(-1, 3)
+        queries = np.asarray(queries, np.float32).reshape(-1, self.dim)
         n = len(queries)
         if n == 0:
             return _InFlightBatch(queries, 0, 0, self.engine_name,
@@ -617,7 +674,7 @@ class ResidentKnnEngine:
             exe = self._get_executable(qpad)
             engine_name = self.engine_name
             args = self._resident_args(engine_name)
-            q = np.full((qpad, 3), PAD_SENTINEL, np.float32)
+            q = np.full((qpad, self.dim), PAD_SENTINEL, np.float32)
             q[:n] = staged
             t0 = time.perf_counter()
             q_dev = self._stage_replicated(q)
@@ -755,6 +812,9 @@ class ResidentKnnEngine:
         return {
             "engine": self.engine_name,
             "merge": self.merge_mode,
+            "score_dtype": self.score_dtype,
+            "score_mode": self.score_mode,
+            "dim": self.dim,
             "degraded_reason": self.degraded_reason,
             "n_points": self.n_points,
             "k": self.k,
@@ -778,6 +838,12 @@ class ResidentKnnEngine:
             "sort_queries": self.sort_queries,
             "tiles_executed": self.timers.counter("tiles_executed"),
             "tiles_skipped": self.timers.counter("tiles_skipped"),
+            # per-score-mode twins: which scorer (MXU matmul-form vs VPU
+            # elementwise) actually burned the executed tiles
+            "tiles_executed_mxu": self.timers.counter("tiles_executed_mxu"),
+            "tiles_skipped_mxu": self.timers.counter("tiles_skipped_mxu"),
+            "tiles_executed_vpu": self.timers.counter("tiles_executed_vpu"),
+            "tiles_skipped_vpu": self.timers.counter("tiles_skipped_vpu"),
             # headline copies of the timers' counters: the stable /stats
             # API surface loadgen + serve_smoke bind to (timers.report()
             # nests the same values among phases/histograms for --timings)

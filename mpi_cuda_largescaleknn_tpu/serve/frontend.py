@@ -177,9 +177,11 @@ class _HostHandler(JsonHttpHandler):
             seq = int(parse_qs(parsed.query).get("seq", ["-1"])[0])
             length = int(self.headers.get("Content-Length", 0))
             raw = self.rfile.read(length)
-            if seq < 0 or len(raw) % 12:
-                raise ValueError("need ?seq=N and an n*12-byte f32 xyz body")
-            q = np.frombuffer(raw, "<f4").reshape(-1, 3)
+            dim = getattr(srv.engine, "dim", 3)
+            if seq < 0 or len(raw) % (4 * dim):
+                raise ValueError(
+                    f"need ?seq=N and an n*{4 * dim}-byte f32 body")
+            q = np.frombuffer(raw, "<f4").reshape(-1, dim)
         except ValueError as e:
             srv.metrics.inc("knn_badrequest_total")
             self._send_json(400, {"error": str(e)})
@@ -237,11 +239,13 @@ class PodFanout:
     """
 
     def __init__(self, host_urls: list[str], *, k: int, max_batch: int,
-                 timeout_s: float = 120.0, timers: PhaseTimers | None = None):
+                 timeout_s: float = 120.0, timers: PhaseTimers | None = None,
+                 dim: int = 3):
         if not host_urls:
             raise ValueError("need at least one host URL")
         self.endpoints = [_HostEndpoint(u) for u in host_urls]
         self.k = int(k)
+        self.dim = int(dim)
         self.max_batch = int(max_batch)
         self.timeout_s = float(timeout_s)
         self.timers = timers if timers is not None else PhaseTimers()
@@ -320,7 +324,7 @@ class PodFanout:
         if self.broken:
             raise PodBrokenError(self.broken)
         q = np.ascontiguousarray(np.asarray(queries, np.float32)
-                                 .reshape(-1, 3))
+                                 .reshape(-1, self.dim))
         with self._lock:
             seq = self._seq
             self._seq += 1
@@ -556,7 +560,8 @@ class _FrontendHandler(JsonHttpHandler):
         t0 = time.perf_counter()
         try:
             q, want_nbrs, timeout_s, binary = parse_knn_body(
-                self.path, self.headers, self.rfile)
+                self.path, self.headers, self.rfile,
+                dim=getattr(srv.fanout, "dim", 3))
         except (ValueError, json.JSONDecodeError) as e:
             srv.metrics.inc("knn_badrequest_total")
             self._send_json(400, {"error": str(e)})
@@ -646,7 +651,7 @@ def pod_config_from_hosts(host_urls: list[str]) -> dict:
         # the Morton permutation each host computes locally)
         for key in ("k", "max_batch", "num_shards", "shape_buckets",
                     "merge", "n_points", "engine", "bucket_size",
-                    "query_buckets", "sort_queries"):
+                    "query_buckets", "sort_queries", "score_dtype", "dim"):
             if e.get(key) != ref.get(key):
                 raise ValueError(
                     f"pod mismatch: host {url} has {key}={e.get(key)!r}, "
@@ -665,7 +670,8 @@ def pod_config_from_hosts(host_urls: list[str]) -> dict:
             f"{ref['num_shards']} — slices would be missing rows")
     return {"k": ref["k"], "max_batch": ref["max_batch"],
             "min_batch": ref["shape_buckets"][0],
-            "num_shards": ref["num_shards"], "n_points": ref["n_points"]}
+            "num_shards": ref["num_shards"], "n_points": ref["n_points"],
+            "dim": ref.get("dim", 3)}
 
 
 def build_frontend(host_urls: list[str], *, host: str = "127.0.0.1",
@@ -677,7 +683,7 @@ def build_frontend(host_urls: list[str], *, host: str = "127.0.0.1",
     ``port=0`` picks a free port (``server.server_address[1]``)."""
     cfg = pod_config_from_hosts(host_urls)
     fanout = PodFanout(host_urls, k=cfg["k"], max_batch=cfg["max_batch"],
-                       timeout_s=timeout_s)
+                       timeout_s=timeout_s, dim=cfg["dim"])
     return FrontendServer((host, port), fanout, max_delay_s=max_delay_s,
                           pipeline_depth=pipeline_depth,
                           max_queue_rows=max_queue_rows,

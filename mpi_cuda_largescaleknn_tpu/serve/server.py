@@ -40,10 +40,12 @@ from mpi_cuda_largescaleknn_tpu.serve.batcher import DynamicBatcher
 from mpi_cuda_largescaleknn_tpu.serve.engine import UnservableShapeError
 
 
-def parse_knn_body(path: str, headers, rfile):
+def parse_knn_body(path: str, headers, rfile, dim: int = 3):
     """Parse one POST /knn request (shared with the pod front end).
 
-    -> (queries f32[n,3], want_neighbors, timeout_s, binary)."""
+    ``dim`` is the serving index's point dimensionality (the engine's
+    ``dim`` attribute — the stack is D-generic; 3 is just the default).
+    -> (queries f32[n,dim], want_neighbors, timeout_s, binary)."""
     qs = parse_qs(urlparse(path).query)
     length = int(headers.get("Content-Length", 0))
     raw = rfile.read(length)
@@ -51,16 +53,17 @@ def parse_knn_body(path: str, headers, rfile):
     timeout_ms = float(qs.get("timeout_ms", [0])[0] or 0)
     neighbors = qs.get("neighbors", ["0"])[0] not in ("0", "", "false")
     if ctype == "application/octet-stream":
-        if len(raw) % 12:
-            raise ValueError("binary body must be n*12 bytes (f32 xyz)")
-        q = np.frombuffer(raw, "<f4").reshape(-1, 3)
+        if len(raw) % (4 * dim):
+            raise ValueError(
+                f"binary body must be n*{4 * dim} bytes (f32 x{dim})")
+        q = np.frombuffer(raw, "<f4").reshape(-1, dim)
         return q, neighbors, timeout_ms / 1e3, True
     obj = json.loads(raw.decode() or "{}")
     q = np.asarray(obj.get("queries", []), np.float32)
     if q.size == 0:
-        q = q.reshape(0, 3)
-    if q.ndim != 2 or q.shape[1] != 3:
-        raise ValueError(f"queries must be [n, 3], got {list(q.shape)}")
+        q = q.reshape(0, dim)
+    if q.ndim != 2 or q.shape[1] != dim:
+        raise ValueError(f"queries must be [n, {dim}], got {list(q.shape)}")
     if not np.all(np.isfinite(q)):
         raise ValueError("queries must be finite")
     timeout_ms = float(obj.get("timeout_ms", timeout_ms) or 0)
@@ -206,8 +209,19 @@ class _Handler(JsonHttpHandler):
                           ("knn_dispatch_stalls_total",
                            b["dispatch_stalls"])):
             lines += [f"# TYPE {name} counter", f"{name} {val}"]
+        # per-score-mode tile attribution: which scorer (MXU matmul-form
+        # vs VPU elementwise) burned the executed tiles — the kernel-bench
+        # speedup's dashboard counterpart
+        lines += ["# TYPE knn_tiles_executed_by_mode_total counter"] + [
+            f'knn_tiles_executed_by_mode_total{{mode="{m}"}} '
+            f'{e[f"tiles_executed_{m}"]}' for m in ("mxu", "vpu")]
+        lines += ["# TYPE knn_tiles_skipped_by_mode_total counter"] + [
+            f'knn_tiles_skipped_by_mode_total{{mode="{m}"}} '
+            f'{e[f"tiles_skipped_{m}"]}' for m in ("mxu", "vpu")]
         lines += ["# TYPE knn_merge_mode gauge",
                   f'knn_merge_mode{{mode="{e["merge"]}"}} 1']
+        lines += ["# TYPE knn_score_dtype gauge",
+                  f'knn_score_dtype{{dtype="{e["score_dtype"]}"}} 1']
         lines += ["# TYPE knn_query_buckets gauge"] + [
             f'knn_query_buckets{{qpad="{q}"}} {b}'
             for q, b in e["query_buckets"].items()]
@@ -247,8 +261,9 @@ class _Handler(JsonHttpHandler):
 
     # ------------------------------------------------------------------ POST
     def _parse_body(self):
-        """-> (queries f32[n,3], want_neighbors, timeout_s, binary)."""
-        return parse_knn_body(self.path, self.headers, self.rfile)
+        """-> (queries f32[n,dim], want_neighbors, timeout_s, binary)."""
+        return parse_knn_body(self.path, self.headers, self.rfile,
+                              dim=getattr(self.server.engine, "dim", 3))
 
     def do_POST(self):
         srv: KnnServer = self.server
@@ -329,5 +344,6 @@ def serve_forever(server: KnnServer, warmup: bool = True) -> None:
     host, port = server.server_address[:2]
     print(f"serving kNN on http://{host}:{port} "
           f"(engine={eng.engine_name}, k={eng.k}, n={eng.n_points}, "
+          f"dim={eng.dim}, score={eng.score_dtype}, "
           f"morton_sort={'on' if eng.sort_queries else 'off'})")
     server.serve_forever()

@@ -25,6 +25,25 @@ contract for the CLIs.
 from __future__ import annotations
 
 
+def shape_dtype_struct(shape, dtype, like=None):
+    """``jax.ShapeDtypeStruct`` carrying ``like``'s varying-manual-axes type
+    when this jax HAS vma typing (``jax.typeof``), a plain struct otherwise.
+
+    The Pallas wrappers' out_shapes must vary over the same mesh axes as
+    the candidate state under shard_map on current jax; on the container's
+    older pin neither ``jax.typeof`` nor the ``vma=`` kwarg exists and the
+    plain struct is the correct (and only) spelling."""
+    import jax
+
+    if like is not None and hasattr(jax, "typeof"):
+        vma = getattr(jax.typeof(like), "vma", frozenset())
+        try:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+        except TypeError:  # vma kwarg not accepted on this jax
+            pass
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def install() -> None:
     import jax
 

@@ -8,18 +8,23 @@ query point itself counts as its own neighbor at distance 0).
 import numpy as np
 
 
-def random_points(n, seed=0, scale=1.0):
+def random_points(n, seed=0, scale=1.0, dim=3):
     rng = np.random.default_rng(seed)
-    return (rng.random((n, 3)) * scale).astype(np.float32)
+    return (rng.random((n, dim)) * scale).astype(np.float32)
 
 
 def pairwise_dist2_np(queries, points):
+    """D-generic squared distances, fixed left-to-right component order —
+    at D=3 the exact ``(dx*dx + dy*dy) + dz*dz`` tree the engines use
+    (numpy never FMA-contracts, so with the engines' opaque-one contraction
+    guard this oracle now matches them BIT FOR BIT, not just to 1 ulp)."""
     q = np.asarray(queries, np.float32)
     p = np.asarray(points, np.float32)
-    dx = q[:, 0:1] - p[None, :, 0]
-    dy = q[:, 1:2] - p[None, :, 1]
-    dz = q[:, 2:3] - p[None, :, 2]
-    return (dx * dx + dy * dy) + dz * dz
+    acc = None
+    for i in range(q.shape[1]):
+        di = q[:, i:i + 1] - p[None, :, i]
+        acc = di * di if acc is None else acc + di * di
+    return acc
 
 
 def kth_nn_dist2(queries, points, k, max_radius=np.inf):

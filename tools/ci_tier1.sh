@@ -21,8 +21,11 @@ if [ "${SERVE_BENCH:-1}" != "0" ] && [ "$rc" -ne 124 ]; then
   # 2 simulated host processes over one global mesh + the fan-out front
   # end vs a single-process twin — deterministic fetched-bytes-per-pod
   # ratio (~hosts x below per-host fetch), oracle-exact gated
-  timeout -k 10 1500 python tools/serve_smoke.py --duration 2 --trials 3 \
-      --locality-bench --multihost-bench \
+  # --kernel-bench adds the distance-kernel section (kernel_compare):
+  # elementwise VPU vs MXU matmul-form scoring at D in {3, 8, 64},
+  # gated on MXU-vs-VPU bitwise exactness; speedups are trajectory data
+  timeout -k 10 1800 python tools/serve_smoke.py --duration 2 --trials 3 \
+      --locality-bench --multihost-bench --kernel-bench \
       --out BENCH_serve.json >/dev/null || { brc=$?; [ "$rc" -eq 0 ] && rc=$brc; }
 fi
 exit $rc
