@@ -68,6 +68,16 @@ answer over POST /shard_knn to the pod front end
   --coordinator A   coordinator address host:port
   --num-hosts N     number of cooperating serving processes
   --host-id I       this process's id in [0, N)
+  --routing M       off | bounds (default off). bounds = shard-local
+                    routing: the hosts stay INDEPENDENT (no coordinator,
+                    no global mesh) — each loads only its row slab
+                    [N*i/H, N*(i+1)/H) of the input, serves full candidate
+                    rows on POST /route_knn, and the front end routes each
+                    query by the hosts' shard bounding boxes
+                    (docs/SERVING.md "Shard-local routing"). Routing wins
+                    need a spatially-ordered input file (the io
+                    partitioner's Morton order); an unordered file stays
+                    exact but routes every query everywhere
 """
 
 
@@ -87,7 +97,8 @@ def parse_serve_args(argv: list[str]) -> dict:
            "max_queue_rows": 4096,
            "timeout_ms": 5000.0, "warmup": True, "timings": False,
            "verbose": False,
-           "coordinator": None, "num_hosts": 1, "host_id": 0}
+           "coordinator": None, "num_hosts": 1, "host_id": 0,
+           "routing": "off"}
     i = 0
     try:
         while i < len(argv):
@@ -132,6 +143,8 @@ def parse_serve_args(argv: list[str]) -> dict:
                 i += 1; opt["num_hosts"] = int(argv[i])
             elif arg == "--host-id":
                 i += 1; opt["host_id"] = int(argv[i])
+            elif arg == "--routing":
+                i += 1; opt["routing"] = argv[i]
             elif arg == "--no-warmup":
                 opt["warmup"] = False
             elif arg == "--timings":
@@ -147,6 +160,12 @@ def parse_serve_args(argv: list[str]) -> dict:
         usage("no input file name specified")
     if opt["k"] < 1:
         usage("no k specified, or invalid k value")
+    if opt["routing"] not in ("off", "bounds"):
+        usage(f"--routing must be off or bounds, got '{opt['routing']}'")
+    if opt["routing"] == "bounds" and opt["coordinator"]:
+        usage("--routing bounds hosts are independent processes — they "
+              "never join a global mesh, so --coordinator is a config "
+              "error (use --routing off for the pod-collective mode)")
     return opt
 
 
@@ -165,39 +184,86 @@ def main(argv: list[str] | None = None) -> int:
         serve_forever,
     )
 
-    if opt["num_hosts"] > 1:
+    routed = opt["routing"] == "bounds"
+    if opt["num_hosts"] > 1 and not routed:
         # pod mode: join the global mesh BEFORE any device query — the
         # engine below then builds over all hosts' devices and its AOT
         # query programs are pod-wide collectives (serve/frontend.py)
         initialize_distributed(opt["coordinator"], opt["num_hosts"],
                                opt["host_id"])
 
-    points = read_points(opt["in_path"])
-    print(f"loaded {len(points)} points from {opt['in_path']}")
+    id_offset = 0
+    if routed:
+        # shard-local routing: this process owns ONE row slab of the index
+        # and serves it independently — no global mesh, global neighbor
+        # ids via the engine's id offset, full candidate rows emitted for
+        # the front end's cross-host fold (serve/frontend.py). Only the
+        # slab is MATERIALIZED: routed hosts exist so each box holds 1/H
+        # of the index, so a whole-file read would defeat the point
+        if not (0 <= opt["host_id"] < opt["num_hosts"]):
+            usage(f"--host-id {opt['host_id']} outside [0, "
+                  f"{opt['num_hosts']})")
+        if opt["in_path"].endswith(".npy"):
+            import numpy as np
+
+            from mpi_cuda_largescaleknn_tpu.models.sharding import (
+                slab_bounds,
+            )
+
+            arr = np.load(opt["in_path"], mmap_mode="r")
+            n_total = len(arr)
+            id_offset, end = slab_bounds(n_total, opt["num_hosts"])[
+                opt["host_id"]]
+            points = np.asarray(arr[id_offset:end], np.float32)
+        else:
+            # the reference's readFilePortion split — identical integer
+            # arithmetic to slab_bounds, so slabs tile [0, N) exactly
+            from mpi_cuda_largescaleknn_tpu.io.reader import (
+                read_file_portion,
+            )
+
+            points, id_offset, n_total = read_file_portion(
+                opt["in_path"], opt["host_id"], opt["num_hosts"])
+        print(f"routed host {opt['host_id']}/{opt['num_hosts']}: loaded "
+              f"rows [{id_offset}:{id_offset + len(points)}) of {n_total} "
+              f"from {opt['in_path']}")
+    else:
+        points = read_points(opt["in_path"])
+        n_total = len(points)
+        print(f"loaded {len(points)} points from {opt['in_path']}")
     engine = ResidentKnnEngine(
         points, opt["k"], mesh=get_mesh(opt["shards"]),
         engine=opt["engine"], bucket_size=opt["bucket_size"],
         max_radius=opt["max_radius"], max_batch=opt["max_batch"],
         min_batch=opt["min_batch"], merge=opt["merge"],
         query_buckets=opt["query_buckets"],
-        score_dtype=opt["score_dtype"])
+        score_dtype=opt["score_dtype"],
+        id_offset=id_offset, emit="candidates" if routed else "final")
 
-    if opt["num_hosts"] > 1:
+    if opt["num_hosts"] > 1 or routed:
         from mpi_cuda_largescaleknn_tpu.serve.frontend import HostSliceServer
 
         server = HostSliceServer((opt["host"], opt["port"]), engine,
+                                 routing=opt["routing"],
                                  verbose=opt["verbose"])
         try:
             if opt["warmup"]:
-                # collective: every host compiles+executes the same bucket
-                # sequence in lock-step before any fan-out traffic lands
+                # pod mode: collective — every host compiles+executes the
+                # same bucket sequence in lock-step before any fan-out
+                # traffic lands. Routed mode: local warmup, no lock-step.
                 info = engine.warmup()
                 print(f"warmup compiles done: {info['per_bucket_s']}")
             server.ready = True
             host, port = server.server_address[:2]
-            print(f"serving pod slice {engine.process_index}/"
-                  f"{engine.process_count} on http://{host}:{port} "
-                  f"(mesh positions {engine.stats()['my_positions']})")
+            if routed:
+                print(f"serving routed slab host {opt['host_id']}/"
+                      f"{opt['num_hosts']} on http://{host}:{port} "
+                      f"(rows [{id_offset}:{id_offset + engine.n_points}) "
+                      f"of {n_total})")
+            else:
+                print(f"serving pod slice {engine.process_index}/"
+                      f"{engine.process_count} on http://{host}:{port} "
+                      f"(mesh positions {engine.stats()['my_positions']})")
             server.serve_forever()
         except KeyboardInterrupt:
             print("shutting down")

@@ -65,6 +65,26 @@ def pad_and_flatten(shards: list[np.ndarray], id_bases: list[int] | None = None,
     return points, ids, counts, npad
 
 
+def slab_aabbs(points: np.ndarray, bounds: list[tuple[int, int]]) -> list[dict]:
+    """Per-slab bounding boxes + point counts, JSON-ready: the serving
+    engine computes these ONCE at index upload and exposes them on /stats,
+    so the pod front end can assemble its routing bounds table
+    (serve/frontend.py ``PodBoundsTable``) without touching the device.
+    An empty slab carries the ``lo/hi = None`` sentinel (count 0) — the
+    router must treat it as unreachable, never as a zero-size box at the
+    origin."""
+    out = []
+    for b, e in bounds:
+        s = np.asarray(points[b:e], np.float32)
+        if len(s) == 0:
+            out.append({"lo": None, "hi": None, "count": 0})
+        else:
+            out.append({"lo": [float(x) for x in s.min(axis=0)],
+                        "hi": [float(x) for x in s.max(axis=0)],
+                        "count": int(len(s))})
+    return out
+
+
 def trim_per_shard(flat: np.ndarray, counts: list[int], npad: int) -> list[np.ndarray]:
     """Undo the padding: per-shard arrays of true length."""
     return [np.asarray(flat[r * npad:r * npad + c]) for r, c in enumerate(counts)]

@@ -30,6 +30,18 @@ single-worker serialized path bit-for-bit.
 Deadlines: a request whose deadline passed while queued is completed with
 ``DeadlineExceeded`` instead of burning engine time on an answer nobody is
 waiting for.
+
+Routed fan-outs (serve/frontend.py ``RoutedPodFanout``) stress the
+``complete`` side: a routed batch's completion performs NETWORK waves
+(fold + escalation re-dispatch), so a pipeline slot can be held well past
+the device time and the per-host sub-batches vary in width. Two
+consequences live here: the routed front end passes ``min_batch=1``
+(a sliver CAN start immediately — independent hosts have no pod-wide
+program to queue behind, so the stall-aware flush floor must not hold it),
+and ``complete`` wall-clock is accounted separately
+(``batch_complete_seconds`` histogram, ``complete_seconds_total`` in
+stats) so escalation cost is attributable instead of vanishing into
+dispatch stalls.
 """
 
 from __future__ import annotations
@@ -119,6 +131,11 @@ class DynamicBatcher:
         self.dispatch_stall_seconds = 0.0
         self.stall_hist = (timers.hist("pipeline_stall_seconds")
                            if timers is not None else LatencyHistogram())
+        # time spent blocked inside query_fn.complete — for routed
+        # fan-outs this includes fold + escalation waves, the number that
+        # explains a long-held pipeline slot
+        self.complete_hist = (timers.hist("batch_complete_seconds")
+                              if timers is not None else LatencyHistogram())
         self._workers: list[threading.Thread] = []
         if self.pipelined:
             self._inflight: queue.Queue = queue.Queue()
@@ -353,7 +370,9 @@ class DynamicBatcher:
                 return
             live, rows, handle, t0 = item
             try:
+                tc = time.perf_counter()
                 dists, nbrs = self._query_fn.complete(handle)
+                self.complete_hist.record(time.perf_counter() - tc)
                 if self._timers is not None:
                     self._timers.hist("batch_exec_seconds").record(
                         time.perf_counter() - t0)
@@ -409,6 +428,8 @@ class DynamicBatcher:
                 "dispatch_stalls": self.dispatch_stalls,
                 "dispatch_stall_seconds": round(
                     self.dispatch_stall_seconds, 6),
+                "complete_seconds_total": round(
+                    self.complete_hist.sum_seconds, 6),
             }
 
     def shutdown(self, wait: bool = True):

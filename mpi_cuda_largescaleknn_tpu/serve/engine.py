@@ -79,6 +79,7 @@ import numpy as np
 from mpi_cuda_largescaleknn_tpu.core.types import PAD_SENTINEL
 from mpi_cuda_largescaleknn_tpu.models.sharding import (
     pad_and_flatten,
+    slab_aabbs,
     slab_bounds,
 )
 from mpi_cuda_largescaleknn_tpu.obs.timers import PhaseTimers
@@ -138,7 +139,8 @@ class ResidentKnnEngine:
                  engine: str = "auto", bucket_size: int = 0,
                  max_radius: float = math.inf, max_batch: int = 1024,
                  min_batch: int = 8, merge: str = "auto",
-                 query_buckets: int = 0, score_dtype: str = "f32"):
+                 query_buckets: int = 0, score_dtype: str = "f32",
+                 id_offset: int = 0, emit: str = "final"):
         import jax
 
         from mpi_cuda_largescaleknn_tpu.ops.distance import (
@@ -161,8 +163,22 @@ class ResidentKnnEngine:
         min_batch = max(8, next_pow2(min_batch))
         max_batch = next_pow2(max_batch)
 
+        if emit not in ("final", "candidates"):
+            raise ValueError(f"emit must be 'final' or 'candidates', "
+                             f"got {emit!r}")
         self.k = int(k)
         self.n_points = len(points)
+        #: global row index of this engine's first point: a routed pod host
+        #: (serve/frontend.py --routing bounds) serves one slab of a larger
+        #: index, and its neighbor ids must be GLOBAL rows — the canonical
+        #: (dist2, id) tie order then matches the replicate-everything pod
+        #: bit for bit, because slab sharding keeps ids ascending by host
+        self.id_offset = int(id_offset)
+        #: what completions carry: "final" = the public (kth-dist, ids)
+        #: contract; "candidates" = full per-candidate (dist2[Q,k], ids)
+        #: rows — a PARTIAL result the routed front end folds across hosts
+        #: (``complete_candidates``)
+        self.emit = emit
         #: point dimensionality — the whole ops/io/serve stack is D-generic
         #: (the matmul-form scorer is what makes high D affordable); only
         #: the Morton admission sort is 3-D-specific and disables itself
@@ -248,7 +264,8 @@ class ResidentKnnEngine:
         #: 2**24; XLA:CPU's integer TopK is a scalar loop), so huge indices
         #: fall back to fold-arrival ties (distances stay exact; only
         #: equal-distance id CHOICES may then differ across geometries)
-        self.canonical_ties = use_tiled and self.n_points < (1 << 24)
+        self.canonical_ties = (use_tiled
+                               and self.id_offset + self.n_points < (1 << 24))
         self.timers = PhaseTimers()
         self.compile_count = 0
         self.degraded_reason: str | None = None
@@ -290,6 +307,13 @@ class ResidentKnnEngine:
         self._index_hi = (points.max(axis=0) if len(points)
                           else np.ones(self.dim))
         bounds = slab_bounds(len(points), self.num_shards)
+        #: per-shard AABB + point count, computed ONCE at upload from the
+        #: host-side slabs (exact — no sentinel rows to mask) and exposed on
+        #: /stats: the pod front end's routing bounds table is assembled
+        #: from these (serve/frontend.py PodBoundsTable). Per-SHARD boxes
+        #: beat one whole-slab box: the router prunes on the min over a
+        #: host's shard bounds, which is tighter than the union box's.
+        self.shard_bounds = slab_aabbs(points, bounds)
         sharding = NamedSharding(self.mesh, P(AXIS))
         if self._multi:
             # pod mode: every host loads the same full point set (serving
@@ -300,8 +324,8 @@ class ResidentKnnEngine:
             my_pos = self._my_pos = my_mesh_positions(self.mesh)
             local_flat, local_ids, _counts, self.npad_local = pad_and_flatten(
                 [points[bounds[s][0]:bounds[s][1]] for s in my_pos],
-                id_bases=[bounds[s][0] for s in my_pos], pad_to=npad,
-                dim=self.dim)
+                id_bases=[bounds[s][0] + self.id_offset for s in my_pos],
+                pad_to=npad, dim=self.dim)
             rows = self.num_shards * npad
             flat = jax.make_array_from_process_local_data(
                 sharding, local_flat, (rows, self.dim))
@@ -312,7 +336,8 @@ class ResidentKnnEngine:
             self._my_pos = list(range(self.num_shards))
             shards = [points[b:e] for b, e in bounds]
             flat, ids, _counts, self.npad_local = pad_and_flatten(
-                shards, id_bases=[b for b, _ in bounds], dim=self.dim)
+                shards, id_bases=[b + self.id_offset for b, _ in bounds],
+                dim=self.dim)
             # the flat resident side serves the bruteforce engine; the
             # bucketed one serves the tiled engines — both stay
             # device-resident for the life of the process (the reference
@@ -375,6 +400,7 @@ class ResidentKnnEngine:
         k, max_radius = self.k, self.max_radius
         num_shards = self.num_shards
         device_merge = self.merge_mode == "device"
+        emit_candidates = self.emit == "candidates"
         canonical = self.canonical_ties
         dim = self.dim
         score_dtype = self.score_dtype
@@ -389,7 +415,13 @@ class ResidentKnnEngine:
             # third output is this device's executed-tile count [1].
             if not device_merge:
                 return st.dist2, st.idx, tiles
-            dists, _d2, idx = device_merge_final(st, num_shards)
+            dists, d2, idx = device_merge_final(st, num_shards)
+            if emit_candidates:
+                # routed serving: emit the full merged candidate rows
+                # (dist2[Q, k]) instead of the kth distances — the front
+                # end's cross-host partial fold needs every candidate, not
+                # just the boundary (the unused dists slice is DCE'd)
+                return d2, idx, tiles
             return dists, idx, tiles
 
         use_tiled = engine_name in ("tiled", "pallas_tiled")
@@ -711,6 +743,10 @@ class ResidentKnnEngine:
             raise RuntimeError(
                 "pod-mode engines emit per-host row slices — use "
                 "complete_slices (the front end assembles the full batch)")
+        if self.emit == "candidates":
+            raise RuntimeError(
+                "emit='candidates' engines return full candidate rows — "
+                "use complete_candidates (the routed front end's fold)")
         a, b, t = batch.fut.result()
         a = np.asarray(a)
         b = np.asarray(b)
@@ -740,6 +776,55 @@ class ResidentKnnEngine:
             out_n[batch.perm] = nbrs
             dists, nbrs = out_d, out_n
         return dists, nbrs
+
+    def complete_candidates(self, batch: _InFlightBatch):
+        """Routed-host ``complete``: block on a dispatched batch and return
+        the full merged candidate rows ``(dist2 f32[n, k], idx i32[n, k])``
+        over THIS engine's points — ascending (dist2, id) per row, -1 ids /
+        radius**2 distances in unfilled slots.
+
+        This is the partial a routed pod host serves (POST /route_knn):
+        the front end folds the per-host rows with the same canonical
+        (dist2, id) discipline (serve/frontend.py ``RoutedPodFanout``), so
+        the folded result is bit-identical to one engine over the union of
+        the hosts' points. Works under both merge placements: the device
+        merge emits the candidate rows in-program (``emit='candidates'``),
+        the host merge keeps the full-width variant of the PR-3 fold.
+        """
+        if batch.n == 0:
+            return (np.full((0, self.k), np.inf, np.float32),
+                    np.full((0, self.k), -1, np.int32))
+        if self._multi:
+            raise RuntimeError(
+                "pod-mode engines emit per-host row slices — routed "
+                "(independent-host) serving never joins a global mesh")
+        if batch.merge_mode == "device" and self.emit != "candidates":
+            raise RuntimeError(
+                "engine was built with emit='final': its device-merge "
+                "programs emit kth distances, not candidate rows — "
+                "construct the engine with emit='candidates'")
+        a, b, t = batch.fut.result()
+        a = np.asarray(a)
+        b = np.asarray(b)
+        self.timers.hist("engine_batch_seconds").record(
+            time.perf_counter() - batch.t0)
+        self.timers.count("fetch_bytes", a.nbytes + b.nbytes)
+        self.timers.count("result_rows", batch.n)
+        self._count_tiles(self._tiles_fetch(t), batch.tiles_possible)
+        if batch.merge_mode == "device":
+            d2, idx = a, b  # already the merged [qpad, k] candidate rows
+        else:
+            with self.timers.phase("host_merge"):
+                d2, idx = _merge_shard_candidates(
+                    a, b, self.num_shards, batch.qpad, self.k, full=True)
+        d2, idx = d2[:batch.n], idx[:batch.n]
+        if batch.perm is not None:
+            out_d = np.empty_like(d2)
+            out_i = np.empty_like(idx)
+            out_d[batch.perm] = d2
+            out_i[batch.perm] = idx
+            d2, idx = out_d, out_i
+        return d2, idx
 
     def complete_slices(self, batch: _InFlightBatch):
         """Pod-mode ``complete``: fetch ONLY this process's addressable row
@@ -825,6 +910,17 @@ class ResidentKnnEngine:
             "process_index": self.process_index,
             "process_count": self.process_count,
             "my_positions": list(self._my_pos),
+            # routed-serving surface: which global rows this engine owns,
+            # what its completions emit, whether its tie order is the
+            # canonical (dist2, id) one the cross-host fold assumes, the
+            # radius cap (None = inf; /stats stays strict JSON), and the
+            # per-shard AABB + count table the front end routes on
+            "row_offset": self.id_offset,
+            "emit": self.emit,
+            "canonical_ties": self.canonical_ties,
+            "max_radius": (None if math.isinf(self.max_radius)
+                           else self.max_radius),
+            "shard_bounds": self.shard_bounds,
             "max_batch": self.max_batch,
             "bucket_size": self.bucket_size,
             "shape_buckets": list(self.shape_buckets),
@@ -853,7 +949,7 @@ class ResidentKnnEngine:
         }
 
 
-def _merge_shard_candidates(d2, idx, num_shards, qpad, k):
+def _merge_shard_candidates(d2, idx, num_shards, qpad, k, full=False):
     """Merge R per-shard top-k candidate blocks into the global top-k.
 
     ``d2``/``idx`` are [R*qpad, k] shard-major. The tie discipline is the
@@ -866,11 +962,17 @@ def _merge_shard_candidates(d2, idx, num_shards, qpad, k):
     output, measurably less host CPU at serving batch sizes — this runs on
     the completion worker's critical path whenever the host path is
     selected (or degraded to).
+
+    ``full=True`` returns the whole merged candidate rows
+    ``(dist2[qpad, k], idx[qpad, k])`` instead of (sqrt-kth, idx) — the
+    routed serving path's partial (``complete_candidates``).
     """
     d2 = d2.reshape(num_shards, qpad, k).transpose(1, 0, 2).reshape(qpad, -1)
     idx = idx.reshape(num_shards, qpad, k).transpose(1, 0, 2).reshape(qpad, -1)
     if num_shards == 1:
         # a single shard's block is already the sorted global top-k
+        if full:
+            return d2, idx
         return np.sqrt(d2[:, k - 1]), idx
     # SOME k smallest per row (boundary ties arbitrary), then the k-th value
     part = np.argpartition(d2, k - 1, axis=1)[:, :k]
@@ -890,4 +992,6 @@ def _merge_shard_candidates(d2, idx, num_shards, qpad, k):
     top_d2 = np.take_along_axis(sel_d2, order, axis=1)
     top_idx = np.take_along_axis(
         idx, np.take_along_axis(sel_cols, order, axis=1), axis=1)
+    if full:
+        return top_d2, top_idx
     return np.sqrt(top_d2[:, k - 1]), top_idx

@@ -38,6 +38,30 @@ drops a sequence number, in-flight collectives cannot complete and the
 front end marks the pod broken (``/healthz`` -> 503, requests -> 500)
 rather than serving partial answers; restart the host processes together
 (docs/SERVING.md "Multi-host serving").
+
+Shard-local routing (``--routing bounds``): the replicate-everything
+fan-out above makes adding hosts add WORK, not capacity — every host
+traverses every batch. The routed mode is the paper's bounds-driven
+demand-matching variant (PAPER.md §0: trees only travel to ranks whose
+bounds can still improve a query; PANDA's distributed bounds pruning,
+PAPERS.md) applied at pod scale: hosts run as INDEPENDENT engines (no
+global mesh, no collectives, no seq ordering), each owning one row-slab of
+the index with GLOBAL neighbor ids (``id_offset``), and serving full
+candidate rows from ``POST /route_knn`` (``engine.emit='candidates'``).
+The front end assembles a ``PodBoundsTable`` from every host's per-shard
+AABBs at startup and, per batch: (wave 1) sends each query only to its
+nearest-bounds host; then folds the returned partials with the canonical
+(dist2, id) merge — commutative, so wave arrival order can never change
+bits — and (escalation waves) re-dispatches exactly the (query, host)
+pairs whose box lower bound can still beat that query's current k-th
+distance, until every skipped host is CERTIFIED unable to contribute
+(``lb * (1 - slack) > kth_dist2``; the slack covers the engines' f32
+rounding so certification can never skip a true neighbor, ties included).
+Clustered traffic certifies most queries after one host — pod throughput
+then scales with hosts instead of trailing one host
+(``serve_smoke.py --routing-bench``); results stay bit-identical to the
+replicate-everything pod because slab sharding keeps ids ascending by
+host, making the pod's shard-major tie discipline THE canonical order.
 """
 
 from __future__ import annotations
@@ -47,6 +71,7 @@ import json
 import threading
 import time
 import urllib.request
+from collections import Counter
 from concurrent.futures import ThreadPoolExecutor
 from http.server import ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
@@ -65,6 +90,7 @@ from mpi_cuda_largescaleknn_tpu.serve.server import (
     ServingMetrics,
     parse_knn_body,
 )
+from mpi_cuda_largescaleknn_tpu.utils.math import aabb_lower_bound_dist2
 
 # -------------------------------------------------------------- host side
 
@@ -72,12 +98,23 @@ from mpi_cuda_largescaleknn_tpu.serve.server import (
 class HostSliceServer(ThreadingHTTPServer):
     """Per-host serving process: one engine slice of the pod.
 
-    Serves the front end only (no public /knn): ``POST /shard_knn?seq=N``
-    with a raw little-endian f32 xyz body dispatches the batch on the
-    GLOBAL mesh — in strict ``seq`` order, because the underlying program
-    is a collective every host must enter identically — and answers with
-    this host's row slices of the pod-final result. /healthz, /stats and
-    /metrics mirror the single-host server's observability surface.
+    Serves the front end only (no public /knn). Two modes:
+
+    - ``routing="off"`` (pod mode): ``POST /shard_knn?seq=N`` with a raw
+      little-endian f32 body dispatches the batch on the GLOBAL mesh — in
+      strict ``seq`` order, because the underlying program is a collective
+      every host must enter identically — and answers with this host's row
+      slices of the pod-final result.
+    - ``routing="bounds"`` (routed mode): the engine is an INDEPENDENT
+      slab server (no global mesh, ``emit='candidates'``);
+      ``POST /route_knn`` dispatches any sub-batch in arrival order (no
+      collectives, so no seq discipline) and answers with the full
+      candidate rows (d2[m,k] + ids[m,k]) the front end folds across
+      hosts.
+
+    /healthz, /stats and /metrics mirror the single-host server's
+    observability surface either way (plus the per-shard AABB table and
+    routed-row counters in routed mode).
     """
 
     daemon_threads = True
@@ -85,8 +122,25 @@ class HostSliceServer(ThreadingHTTPServer):
     #: before giving up (a lost lower seq means the pod is wedged anyway)
     seq_timeout_s = 120.0
 
-    def __init__(self, addr, engine, *, verbose: bool = False):
+    def __init__(self, addr, engine, *, routing: str = "off",
+                 verbose: bool = False):
+        if routing not in ("off", "bounds"):
+            raise ValueError(f"routing must be 'off' or 'bounds', "
+                             f"got {routing!r}")
+        if routing == "bounds":
+            if getattr(engine, "emit", "final") != "candidates":
+                raise ValueError(
+                    "routed host serving needs an engine built with "
+                    "emit='candidates' — the front end's partial merge "
+                    "folds full candidate rows, not kth distances")
+            if getattr(engine, "process_count", 1) > 1:
+                raise ValueError(
+                    "routed hosts are independent processes — do not join "
+                    "a global mesh (launch without --coordinator)")
+            # the front end pipelines depth-2 sub-batches per host
+            engine.set_launch_workers(2)
         self.engine = engine
+        self.routing = routing
         self.ready = False
         self.verbose = verbose
         self._loop_entered = False
@@ -131,6 +185,14 @@ class HostSliceServer(ThreadingHTTPServer):
                 self._seq_cond.notify_all()
         return self.engine.complete_slices(handle)
 
+    def run_routed(self, queries: np.ndarray):
+        """Routed mode: dispatch a sub-batch in arrival order (the engine's
+        own lock + FIFO launch pool serialize device entry; nothing is
+        collective, so concurrent handler threads are fine) and return the
+        full candidate rows ``(d2[m, k], idx[m, k])``."""
+        handle = self.engine.dispatch(queries)
+        return self.engine.complete_candidates(handle)
+
 
 class _HostHandler(JsonHttpHandler):
     def do_GET(self):
@@ -138,12 +200,15 @@ class _HostHandler(JsonHttpHandler):
         path = urlparse(self.path).path
         if path == "/healthz":
             body = {"status": "ok" if srv.ready else "warming",
-                    "role": "host-slice",
+                    "role": ("host-routed" if srv.routing == "bounds"
+                             else "host-slice"),
+                    "routing": srv.routing,
                     "process_index": srv.engine.process_index,
                     "next_seq": srv.next_seq}
             self._send_json(200 if srv.ready else 503, body)
         elif path == "/stats":
             self._send_json(200, {"engine": srv.engine.stats(),
+                                  "routing": srv.routing,
                                   "next_seq": srv.next_seq,
                                   "server": dict(srv.metrics.counters)})
         elif path == "/metrics":
@@ -155,11 +220,18 @@ class _HostHandler(JsonHttpHandler):
                     ("knn_tiles_executed_total", e["tiles_executed"]),
                     ("knn_tiles_skipped_total", e["tiles_skipped"])):
                 lines += [f"# TYPE {name} counter", f"{name} {val}"]
+            # server-side request counters (incl. the routed-row counter
+            # knn_routed_rows_total in routed mode)
+            for name, val in sorted(srv.metrics.counters.items()):
+                lines += [f"# TYPE {name} counter", f"{name} {val}"]
             for name, val in (("knn_ready", int(srv.ready)),
                               ("knn_compile_count", e["compile_count"]),
                               ("knn_num_shards", e["num_shards"]),
                               ("knn_host_process_index", e["process_index"]),
-                              ("knn_host_next_seq", srv.next_seq)):
+                              ("knn_host_next_seq", srv.next_seq),
+                              ("knn_host_row_offset", e["row_offset"]),
+                              ("knn_host_routed",
+                               int(srv.routing == "bounds"))):
                 lines += [f"# TYPE {name} gauge", f"{name} {val}"]
             self._send(200, ("\n".join(lines) + "\n").encode(),
                        "text/plain; version=0.0.4")
@@ -169,28 +241,45 @@ class _HostHandler(JsonHttpHandler):
     def do_POST(self):
         srv: HostSliceServer = self.server
         parsed = urlparse(self.path)
-        if parsed.path != "/shard_knn":
-            self._send_json(404, {"error": "POST /shard_knn only"})
+        want = "/route_knn" if srv.routing == "bounds" else "/shard_knn"
+        if parsed.path != want:
+            self._send_json(404, {
+                "error": f"this host serves POST {want} only "
+                         f"(routing={srv.routing})"})
             return
         srv.metrics.inc("knn_requests_total")
         try:
-            seq = int(parse_qs(parsed.query).get("seq", ["-1"])[0])
             length = int(self.headers.get("Content-Length", 0))
             raw = self.rfile.read(length)
             dim = getattr(srv.engine, "dim", 3)
-            if seq < 0 or len(raw) % (4 * dim):
-                raise ValueError(
-                    f"need ?seq=N and an n*{4 * dim}-byte f32 body")
+            if len(raw) % (4 * dim):
+                raise ValueError(f"need an n*{4 * dim}-byte f32 body")
+            if srv.routing == "off":
+                seq = int(parse_qs(parsed.query).get("seq", ["-1"])[0])
+                if seq < 0:
+                    raise ValueError("need ?seq=N (the pod program order)")
             q = np.frombuffer(raw, "<f4").reshape(-1, dim)
         except ValueError as e:
             srv.metrics.inc("knn_badrequest_total")
             self._send_json(400, {"error": str(e)})
             return
         try:
-            rows, dists, nbrs = srv.run_in_order(seq, q)
+            if srv.routing == "bounds":
+                d2, idx = srv.run_routed(q)
+            else:
+                rows, dists, nbrs = srv.run_in_order(seq, q)
         except Exception as e:  # noqa: BLE001 - the front end retries/fails
             srv.metrics.inc("knn_error_total")
             self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        if srv.routing == "bounds":
+            srv.metrics.inc("knn_rows_total", len(q))
+            srv.metrics.inc("knn_routed_rows_total", len(q))
+            body = (np.ascontiguousarray(d2, "<f4").tobytes()
+                    + np.ascontiguousarray(idx, "<i4").tobytes())
+            self._send(200, body, "application/octet-stream",
+                       extra=[("X-Knn-Rows", str(len(q))),
+                              ("X-Knn-K", str(srv.engine.k))])
             return
         srv.metrics.inc("knn_rows_total", len(rows))
         body = (np.ascontiguousarray(rows, "<i4").tobytes()
@@ -430,6 +519,287 @@ class PodFanout:
             }
 
 
+def routing_cert_slack(dim: int) -> float:
+    """Relative certification slack: a host is only CERTIFIED skippable
+    when ``lb * (1 - slack) > kth_dist2``. The box bound is computed in
+    f64, but the engines score pairs in f32 with relative error bounded by
+    ~(D+2) * 2^-24 (one rounding per multiply/add of the D-term sum), so a
+    point exactly ON a box face could score BELOW the exact bound. The
+    slack must therefore GROW with the dimension — a constant that covers
+    D=3 silently under-covers D=256 — so it is 16 x the error-model bound
+    with a 1e-5 floor: negligible pruning loss at any D, and the
+    non-strict ``<=`` comparison keeps every exact-tie host, which is what
+    preserves tie-id bitwise parity with replicate-everything."""
+    return max(1e-5, 16.0 * (dim + 2) * 2.0 ** -24)
+
+
+class PodBoundsTable:
+    """The routing decision table: every host's per-shard AABBs + counts.
+
+    Assembled once at front-end startup from the hosts' /stats
+    (``pod_config_from_hosts``). ``lower_bounds(q)`` returns, per (query,
+    host), the squared distance below which NO point of that host can lie
+    — the min over the host's per-shard box bounds (tighter than one
+    whole-slab box). Empty shards carry the ``lo/hi = None`` sentinel and
+    contribute nothing; a host with ONLY empty shards is unreachable
+    (bound +inf) and is never routed to nor escalated to.
+    """
+
+    def __init__(self, hosts: list[dict], dim: int):
+        self.dim = int(dim)
+        self.num_hosts = len(hosts)
+        self.host_points = [int(h["n_points"]) for h in hosts]
+        los, his, owner = [], [], []
+        for hid, h in enumerate(hosts):
+            for sb in h["shards"]:
+                if sb.get("count", 0) > 0:
+                    if sb.get("lo") is None or sb.get("hi") is None:
+                        raise ValueError(
+                            f"host {hid} shard bounds malformed: "
+                            f"count {sb['count']} but no lo/hi box")
+                    los.append(sb["lo"])
+                    his.append(sb["hi"])
+                    owner.append(hid)
+        self._lo = np.asarray(los, np.float64).reshape(-1, self.dim)
+        self._hi = np.asarray(his, np.float64).reshape(-1, self.dim)
+        self._owner = np.asarray(owner, np.int64)
+
+    def lower_bounds(self, queries: np.ndarray) -> np.ndarray:
+        """f64[n, H] squared lower-bound distance per (query, host);
+        +inf for hosts with no points."""
+        q = np.asarray(queries, np.float64).reshape(-1, self.dim)
+        out = np.full((len(q), self.num_hosts), np.inf)
+        if len(self._lo) == 0 or len(q) == 0:
+            return out
+        lb = aabb_lower_bound_dist2(q, self._lo, self._hi)
+        for h in range(self.num_hosts):
+            sel = self._owner == h
+            if sel.any():
+                out[:, h] = lb[:, sel].min(axis=1)
+        return out
+
+
+class RoutedPodFanout(PodFanout):
+    """Bounds-routed fan-out: each query visits only the hosts whose shard
+    boxes can still improve it, instead of the whole pod.
+
+    ``dispatch`` (wave 1) computes the bounds table's lower bounds and
+    posts each query to its single nearest-bounds host (ties -> lowest
+    host index). ``complete`` joins the wave, folds the returned candidate
+    rows with the canonical (dist2, id) merge — commutative, so the fold
+    cannot depend on arrival order — then repeats: any (query, host) pair
+    with ``lb * (1 - slack) <= kth_dist2`` and not yet visited is
+    re-dispatched in an escalation wave, until every skipped host is
+    certified unable to contribute (monotone radius ⇒ the loop terminates;
+    in practice one escalation wave at most). Queries with fewer than k
+    candidates keep an infinite radius, so they escalate to every
+    reachable host — exactness is never traded for routing.
+
+    Results are bit-identical to the replicate-everything pod (ties
+    included) when the hosts' engines run the canonical tie order — the
+    default; ``pod_config_from_hosts`` warns otherwise.
+    """
+
+    def __init__(self, host_urls: list[str], *, k: int, max_batch: int,
+                 bounds: PodBoundsTable, timeout_s: float = 120.0,
+                 timers: PhaseTimers | None = None, dim: int = 3):
+        super().__init__(host_urls, k=k, max_batch=max_batch,
+                         timeout_s=timeout_s, timers=timers, dim=dim)
+        if bounds.num_hosts != len(self.endpoints):
+            raise ValueError(f"bounds table covers {bounds.num_hosts} "
+                             f"hosts, fan-out has {len(self.endpoints)}")
+        self.bounds = bounds
+        self.routing_mode = "bounds"
+        self.cert_slack = routing_cert_slack(self.dim)
+        # routing accounting (under self._lock)
+        self.escalations = 0
+        self.escalation_waves = 0
+        self.hosts_per_query: Counter = Counter()
+        for ep in self.endpoints:
+            ep.routed_rows = 0
+
+    # ------------------------------------------------------------- transport
+
+    def _post_route(self, ep: _HostEndpoint, body: bytes, m: int):
+        """POST one sub-batch to one routed host; parse its candidate rows.
+        Returns (d2 f32[m,k], idx i32[m,k], seconds)."""
+        k = self.k
+        t0 = time.perf_counter()
+        try:
+            conn = self._conn(ep)
+            conn.request("POST", f"{ep.prefix}/route_knn", body=body,
+                         headers={"Content-Type":
+                                  "application/octet-stream"})
+            resp = conn.getresponse()
+            payload = resp.read()
+            if resp.status != 200:
+                raise PodBrokenError(
+                    f"host {ep.url} answered {resp.status}: "
+                    f"{payload[:300].decode(errors='replace')}")
+            got = int(resp.getheader("X-Knn-Rows", "-1"))
+            kk = int(resp.getheader("X-Knn-K", str(k)))
+            if got != m or kk != k or len(payload) != 8 * m * k:
+                raise PodBrokenError(
+                    f"host {ep.url} partial malformed: rows={got} (want "
+                    f"{m}) k={kk} bytes={len(payload)}")
+            d2 = np.frombuffer(payload, "<f4",
+                               count=m * k).reshape(m, k)
+            idx = np.frombuffer(payload, "<i4", count=m * k,
+                                offset=4 * m * k).reshape(m, k)
+        except PodBrokenError:
+            self._drop_conn(ep)
+            raise
+        except Exception as e:
+            self._drop_conn(ep)
+            raise PodBrokenError(
+                f"host {ep.url} unreachable: "
+                f"{type(e).__name__}: {e}") from e
+        return d2, idx, time.perf_counter() - t0
+
+    def _submit_wave(self, q: np.ndarray, rows_by_host) -> list:
+        """Post per-host sub-batches concurrently; returns
+        [(host_i, rows, future)] for the non-empty ones."""
+        futs = []
+        for h, rows in rows_by_host:
+            if len(rows) == 0:
+                continue
+            body = np.ascontiguousarray(q[rows], "<f4").tobytes()
+            futs.append((h, rows,
+                         self._pool.submit(self._post_route,
+                                           self.endpoints[h], body,
+                                           len(rows))))
+        return futs
+
+    # ---------------------------------------------------------- query_fn API
+
+    def dispatch(self, queries: np.ndarray):
+        """Wave 1: each query to its nearest-bounds host, PLUS every host
+        whose boxes contain it (non-blocking). A zero lower bound can
+        never be certified away (0 <= kth_dist2 always), so an
+        inside-the-box host would be escalated to unconditionally —
+        visiting it in wave 1 spends the same rows one round trip
+        earlier, which is most of the boundary traffic's latency."""
+        if self.broken:
+            raise PodBrokenError(self.broken)
+        q = np.ascontiguousarray(np.asarray(queries, np.float32)
+                                 .reshape(-1, self.dim))
+        n = len(q)
+        lb = self.bounds.lower_bounds(q)
+        visited = np.zeros((n, len(self.endpoints)), bool)
+        futs = []
+        if n:
+            first = np.argmin(lb, axis=1)
+            reachable = np.isfinite(lb[np.arange(n), first])
+            visited |= lb <= 0.0
+            visited[np.nonzero(reachable)[0], first[reachable]] = True
+            waves = [(h, np.nonzero(visited[:, h])[0])
+                     for h in range(len(self.endpoints))]
+            futs = self._submit_wave(q, waves)
+        return {"q": q, "n": n, "lb": lb, "visited": visited,
+                "futs": futs, "t0": time.perf_counter()}
+
+    def complete(self, handle):
+        """Fold wave partials; escalate uncertified (query, host) pairs."""
+        n, k = handle["n"], self.k
+        cur_d2 = np.full((n, k), np.inf, np.float32)
+        cur_idx = np.full((n, k), -1, np.int32)
+        if n == 0:
+            return np.zeros(0, np.float32), cur_idx
+        q, visited = handle["q"], handle["visited"]
+        # the dim-scaled slack makes the certification conservative
+        # against the engines' f32 rounding (routing_cert_slack)
+        lb_safe = handle["lb"] * (1.0 - self.cert_slack)
+        reachable = np.isfinite(lb_safe)
+        futs = handle["futs"]
+        dts = []
+        wave = 1
+        while True:
+            err: PodBrokenError | None = None
+            for h, rows, fut in futs:
+                ep = self.endpoints[h]
+                try:
+                    d2, idx, dt = fut.result()
+                except PodBrokenError as e:
+                    with self._lock:
+                        ep.errors += 1
+                        ep.last_error = str(e)
+                    err = err or e
+                    continue
+                with self._lock:
+                    ep.ok += 1
+                    ep.latency.record(dt)
+                    ep.routed_rows += len(rows)
+                dts.append(dt)
+                _fold_candidates(cur_d2, cur_idx, rows, d2, idx, k)
+            if err is not None:
+                # certification needs every routed host's answer: a lost
+                # partial is not degradable (same fail-stop contract as
+                # the replicate-everything pod)
+                with self._lock:
+                    self.broken = self.broken or str(err)
+                raise err
+            r2 = cur_d2[:, k - 1].astype(np.float64)
+            need = (~visited) & reachable & (lb_safe <= r2[:, None])
+            if not need.any():
+                break
+            with self._lock:
+                if wave == 1:
+                    self.escalations += int(need.any(axis=1).sum())
+                self.escalation_waves += 1
+            wave += 1
+            waves = [(h, np.nonzero(need[:, h])[0])
+                     for h in range(len(self.endpoints))]
+            visited |= need
+            futs = self._submit_wave(q, waves)
+        with self._lock:
+            self.batches += 1
+            self.hosts_per_query.update(
+                visited.sum(axis=1).astype(int).tolist())
+            if len(dts) > 1:
+                spread = max(dts) - min(dts)
+                self.straggler_seconds += spread
+                self.timers.hist("fanout_straggler_seconds").record(spread)
+        self.timers.hist("fanout_batch_seconds").record(
+            time.perf_counter() - handle["t0"])
+        return np.sqrt(cur_d2[:, k - 1]), cur_idx
+
+    # ------------------------------------------------------------------ admin
+
+    def stats(self) -> dict:
+        s = super().stats()
+        with self._lock:
+            total_q = sum(self.hosts_per_query.values())
+            total_h = sum(c * v for c, v in self.hosts_per_query.items())
+            s["routing"] = {
+                "mode": "bounds",
+                "escalations": self.escalations,
+                "escalation_waves": self.escalation_waves,
+                "routed_rows": {ep.url: ep.routed_rows
+                                for ep in self.endpoints},
+                "hosts_per_query": {str(c): int(v) for c, v in
+                                    sorted(self.hosts_per_query.items())},
+                "hosts_per_query_mean": round(total_h / total_q, 4)
+                if total_q else None,
+            }
+        return s
+
+
+def _fold_candidates(cur_d2, cur_idx, rows, d2, idx, k):
+    """Fold one host's candidate rows into the running per-query top-k
+    under the canonical (dist2, id) total order — ops/candidates.py
+    ``merge_candidates(canonical=True)`` in numpy. Commutative and
+    associative (ids are unique), so wave/host arrival order can never
+    change the folded bits; init slots (idx -1) still win their ties at
+    the radius cutoff, preserving the engines' strict-< adoption."""
+    cat_d2 = np.concatenate([cur_d2[rows], np.asarray(d2, np.float32)],
+                            axis=1)
+    cat_idx = np.concatenate([cur_idx[rows], np.asarray(idx, np.int32)],
+                             axis=1)
+    order = np.lexsort((cat_idx, cat_d2), axis=1)[:, :k]
+    cur_d2[rows] = np.take_along_axis(cat_d2, order, axis=1)
+    cur_idx[rows] = np.take_along_axis(cat_idx, order, axis=1)
+
+
 class FrontendServer(ThreadingHTTPServer):
     """Public pod front end: the single-host server's exact HTTP contract
     (POST /knn JSON + binary, /healthz, /stats, /metrics) backed by a
@@ -539,6 +909,32 @@ class _FrontendHandler(JsonHttpHandler):
                       f'knn_host_errors_total{{host="{url}"}} {h["errors"]}']
             if p99 is not None:
                 lines += [f'knn_host_p99_seconds{{host="{url}"}} {p99}']
+        # shard-local routing observability: escalation + per-host routed
+        # rows + the hosts-visited-per-query histogram (the routing win as
+        # a number: mean ~1 = clustered traffic certifying after one host,
+        # mean ~H = incoherent traffic degenerating to replicate-everything)
+        routing = f.get("routing")
+        if routing:
+            lines += ["# TYPE knn_routing_escalations_total counter",
+                      f"knn_routing_escalations_total "
+                      f"{routing['escalations']}",
+                      "# TYPE knn_routing_escalation_waves_total counter",
+                      f"knn_routing_escalation_waves_total "
+                      f"{routing['escalation_waves']}"]
+            lines += ["# TYPE knn_routed_rows_total counter"] + [
+                f'knn_routed_rows_total{{host="{u}"}} {v}'
+                for u, v in routing["routed_rows"].items()]
+            hpq = {int(c): v for c, v in routing["hosts_per_query"].items()}
+            total = sum(hpq.values())
+            hsum = sum(c * v for c, v in hpq.items())
+            lines += ["# TYPE knn_hosts_per_query histogram"]
+            cum = 0
+            for c in sorted(hpq):
+                cum += hpq[c]
+                lines += [f'knn_hosts_per_query_bucket{{le="{c}"}} {cum}']
+            lines += [f'knn_hosts_per_query_bucket{{le="+Inf"}} {total}',
+                      f"knn_hosts_per_query_sum {hsum}",
+                      f"knn_hosts_per_query_count {total}"]
         lines += srv.metrics.latency.prometheus_lines(
             "knn_request_latency_seconds")
         for src, prom in (("fanout_batch_seconds", "knn_fanout_batch_seconds"),
@@ -631,16 +1027,88 @@ def wait_hosts_ready(host_urls: list[str], timeout_s: float = 600.0,
         time.sleep(poll_s)
 
 
-def pod_config_from_hosts(host_urls: list[str]) -> dict:
-    """Scrape every host's /stats and validate the pod is coherent: same
-    k / max_batch / shape buckets / merge=device, process_count matching
-    the host list, and mesh positions covering the whole axis. Returns
-    {"k", "max_batch", "min_batch", "num_shards", "n_points"}."""
-    stats = []
+def pod_config_from_hosts(host_urls: list[str],
+                          routing: str = "auto") -> dict:
+    """Scrape every host's /stats, detect the serving mode, and validate
+    the pod is coherent.
+
+    ``routing="auto"`` adopts whatever mode the hosts were launched in
+    (they must all agree); "off"/"bounds" additionally assert it. Pod mode
+    (off) validates: same k / max_batch / shape buckets / merge=device,
+    process_count matching the host list, mesh positions covering the
+    whole axis. Routed mode (bounds) validates: every host independent
+    (process_count 1) with emit='candidates', same k / dim / score config /
+    radius cap, and the hosts' row slabs tiling [0, N) with no gap or
+    overlap — a hole would silently drop real neighbors. Returns the
+    front-end construction config (routed configs carry ``host_urls``
+    re-ordered ascending by row offset plus the bounds-table inputs)."""
+    if routing not in ("auto", "off", "bounds"):
+        raise ValueError(f"routing must be auto|off|bounds, got {routing!r}")
+    raw = []
     for url in host_urls:
         with urllib.request.urlopen(url.rstrip("/") + "/stats",
                                     timeout=10.0) as r:
-            stats.append(json.loads(r.read().decode())["engine"])
+            raw.append(json.loads(r.read().decode()))
+    modes = {s.get("routing", "off") for s in raw}
+    if len(modes) != 1:
+        raise ValueError(f"hosts disagree on serving mode: {sorted(modes)} "
+                         "— launch every host with the same --routing")
+    mode = modes.pop()
+    if routing != "auto" and routing != mode:
+        raise ValueError(f"front end asked for routing='{routing}' but the "
+                         f"hosts serve routing='{mode}'")
+    stats = [s["engine"] for s in raw]
+    if mode == "bounds":
+        ref = stats[0]
+        for url, e in zip(host_urls, stats):
+            # routed hosts answer independently, so only the result
+            # CONTRACT must agree — k, dim, radius semantics, score dtype
+            # (distances must be the same f32 values on every host) — plus
+            # the candidate-emission wire format
+            for key in ("k", "dim", "max_radius", "score_dtype",
+                        "max_batch"):
+                if e.get(key) != ref.get(key):
+                    raise ValueError(
+                        f"routed pod mismatch: host {url} has "
+                        f"{key}={e.get(key)!r}, host {host_urls[0]} has "
+                        f"{ref.get(key)!r}")
+            if e.get("emit") != "candidates":
+                raise ValueError(f"host {url} serves emit={e.get('emit')!r};"
+                                 " routed hosts must emit candidates")
+            if e.get("process_count", 1) > 1:
+                raise ValueError(f"host {url} joined a global mesh "
+                                 "(process_count > 1) — routed hosts are "
+                                 "independent processes")
+        if not all(e.get("canonical_ties", False) for e in stats):
+            print("warning: a routed host serves without canonical "
+                  "(dist2, id) ties — distances stay exact, but "
+                  "equal-distance neighbor-id choices may differ from the "
+                  "replicate-everything pod")
+        order = sorted(range(len(stats)),
+                       key=lambda i: stats[i].get("row_offset", 0))
+        offset = 0
+        bounds_hosts = []
+        for i in order:
+            e = stats[i]
+            if e.get("row_offset", 0) != offset:
+                raise ValueError(
+                    f"routed host slabs do not tile the index: host "
+                    f"{host_urls[i]} starts at row {e.get('row_offset')}, "
+                    f"expected {offset} — a gap or overlap would drop or "
+                    "double-count neighbors")
+            bounds_hosts.append({"row_offset": e["row_offset"],
+                                 "n_points": e["n_points"],
+                                 "shards": e["shard_bounds"]})
+            offset += e["n_points"]
+        return {"routing": "bounds",
+                "host_urls": [host_urls[i] for i in order],
+                "k": ref["k"], "dim": ref.get("dim", 3),
+                "max_batch": min(e["max_batch"] for e in stats),
+                # routed sub-batches start the moment a host is idle (no
+                # pod-wide program to queue behind), so the batcher's
+                # stall-aware flush floor drops to 1 row
+                "min_batch": 1,
+                "n_points": offset, "bounds_hosts": bounds_hosts}
     ref = stats[0]
     covered: set[int] = set()
     for url, e in zip(host_urls, stats):
@@ -668,7 +1136,9 @@ def pod_config_from_hosts(host_urls: list[str]) -> dict:
         raise ValueError(
             f"host list covers mesh positions {sorted(covered)} of "
             f"{ref['num_shards']} — slices would be missing rows")
-    return {"k": ref["k"], "max_batch": ref["max_batch"],
+    return {"routing": "off",
+            "host_urls": list(host_urls),
+            "k": ref["k"], "max_batch": ref["max_batch"],
             "min_batch": ref["shape_buckets"][0],
             "num_shards": ref["num_shards"], "n_points": ref["n_points"],
             "dim": ref.get("dim", 3)}
@@ -678,12 +1148,22 @@ def build_frontend(host_urls: list[str], *, host: str = "127.0.0.1",
                    port: int = 8080, max_delay_s: float = 0.002,
                    pipeline_depth: int = 2, max_queue_rows: int = 4096,
                    default_timeout_s: float = 5.0, timeout_s: float = 120.0,
+                   routing: str = "auto",
                    verbose: bool = False) -> FrontendServer:
     """Validate the pod and construct (but do not start) a FrontendServer;
-    ``port=0`` picks a free port (``server.server_address[1]``)."""
-    cfg = pod_config_from_hosts(host_urls)
-    fanout = PodFanout(host_urls, k=cfg["k"], max_batch=cfg["max_batch"],
-                       timeout_s=timeout_s, dim=cfg["dim"])
+    ``port=0`` picks a free port (``server.server_address[1]``).
+    ``routing`` selects the fan-out: "off" = replicate-everything pod,
+    "bounds" = shard-local routing, "auto" = whatever the hosts serve."""
+    cfg = pod_config_from_hosts(host_urls, routing=routing)
+    if cfg["routing"] == "bounds":
+        table = PodBoundsTable(cfg["bounds_hosts"], cfg["dim"])
+        fanout: PodFanout = RoutedPodFanout(
+            cfg["host_urls"], k=cfg["k"], max_batch=cfg["max_batch"],
+            bounds=table, timeout_s=timeout_s, dim=cfg["dim"])
+    else:
+        fanout = PodFanout(cfg["host_urls"], k=cfg["k"],
+                           max_batch=cfg["max_batch"],
+                           timeout_s=timeout_s, dim=cfg["dim"])
     return FrontendServer((host, port), fanout, max_delay_s=max_delay_s,
                           pipeline_depth=pipeline_depth,
                           max_queue_rows=max_queue_rows,
@@ -702,6 +1182,11 @@ FRONTEND_FLAGS = """
   --max-queue-rows N  admission cap on queued+running rows (default 4096)
   --timeout-ms F    default per-request deadline (default 5000)
   --wait-ready-s F  how long to wait for host warmup (default 600)
+  --routing M       auto | off | bounds (default auto = adopt the hosts'
+                    mode): off replicates every batch pod-wide; bounds
+                    routes each query only to hosts whose shard AABBs can
+                    beat its current k-th distance, with certified
+                    escalation (docs/SERVING.md "Shard-local routing")
   --verbose         log each HTTP request to stderr
 """
 
@@ -713,7 +1198,7 @@ def main(argv: list[str] | None = None) -> int:
     opt = {"hosts": "", "port": 8080, "host": "127.0.0.1",
            "max_delay_ms": 2.0, "pipeline_depth": 2,
            "max_queue_rows": 4096, "timeout_ms": 5000.0,
-           "wait_ready_s": 600.0, "verbose": False}
+           "wait_ready_s": 600.0, "routing": "auto", "verbose": False}
     i = 0
     try:
         while i < len(args):
@@ -734,6 +1219,8 @@ def main(argv: list[str] | None = None) -> int:
                 i += 1; opt["timeout_ms"] = float(args[i])
             elif a == "--wait-ready-s":
                 i += 1; opt["wait_ready_s"] = float(args[i])
+            elif a == "--routing":
+                i += 1; opt["routing"] = args[i]
             elif a == "--verbose":
                 opt["verbose"] = True
             else:
@@ -754,10 +1241,13 @@ def main(argv: list[str] | None = None) -> int:
         max_delay_s=opt["max_delay_ms"] / 1e3,
         pipeline_depth=opt["pipeline_depth"],
         max_queue_rows=opt["max_queue_rows"],
-        default_timeout_s=opt["timeout_ms"] / 1e3, verbose=opt["verbose"])
+        default_timeout_s=opt["timeout_ms"] / 1e3,
+        routing=opt["routing"], verbose=opt["verbose"])
     server.ready = True
     h, p = server.server_address[:2]
-    print(f"pod front end on http://{h}:{p} fanning to {len(hosts)} host(s)")
+    mode = getattr(server.fanout, "routing_mode", "off")
+    print(f"pod front end on http://{h}:{p} fanning to {len(hosts)} host(s) "
+          f"(routing={mode})")
     try:
         server.serve_forever()
     except KeyboardInterrupt:

@@ -80,7 +80,9 @@ class ServingMetrics:
 
     def inc(self, name: str, by: int = 1):
         with self._lock:
-            self.counters[name] += by
+            # setdefault-style: endpoint-specific counters (e.g. the routed
+            # hosts' knn_routed_rows_total) appear on first increment
+            self.counters[name] = self.counters.get(name, 0) + by
 
 
 class KnnServer(ThreadingHTTPServer):

@@ -1,0 +1,440 @@
+"""Shard-local routing: bounds-pruned pod fan-out == replicate-everything.
+
+The routed pod (serve/frontend.py ``RoutedPodFanout`` + per-host
+``--routing bounds`` slab engines) must be BITWISE identical — distances
+AND neighbor ids, ties included — to one engine over the union of the
+hosts' points, which PR-5 proved byte-identical to the replicate-everything
+pod. The fixture is adversarial on purpose:
+
+- host 0 owns cluster A (rows 0..294) PLUS five outlier rows (295..299)
+  that are exact coordinate copies of host 1's rows 595..599 — so host 0's
+  bounding boxes overlap host 1's region (the nearest-bounds wave picks the
+  WRONG host for B-region queries, forcing the escalation second wave) and
+  distance-0 ties span hosts (any tie-discipline divergence shows up as an
+  id mismatch).
+- host 1 owns cluster B (rows 300..599), spatially disjoint from A — so
+  A-region queries must CERTIFY after one host (the routing win).
+
+Plus bounds-table unit tests (sentinel/empty shards) and the
+radius-capped / under-full fold discipline without HTTP in the loop.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+K = 5
+
+
+def _post_knn(url, q, timeout=120):
+    req = urllib.request.Request(
+        url + "/knn",
+        data=json.dumps({"queries": np.asarray(q).tolist(),
+                         "neighbors": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _routed_points():
+    """600 rows: [0:295) cluster A, [295:300) copies of rows [595:600)
+    (B-region outliers inside host 0's slab), [300:600) cluster B."""
+    from tests.oracle import random_points
+
+    a = random_points(295, seed=41, scale=0.4)
+    b = (random_points(300, seed=42, scale=0.4) + np.float32(0.6))
+    return np.concatenate([a, b[-5:], b]).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def routed_pod():
+    """Two in-process routed slab hosts (no global mesh — that is the
+    point) + their URLs + the full point set."""
+    from mpi_cuda_largescaleknn_tpu.models.sharding import slab_bounds
+    from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+    from mpi_cuda_largescaleknn_tpu.serve.engine import ResidentKnnEngine
+    from mpi_cuda_largescaleknn_tpu.serve.frontend import HostSliceServer
+
+    points = _routed_points()
+    servers = []
+    for b, e in slab_bounds(len(points), 2):
+        eng = ResidentKnnEngine(points[b:e], K, mesh=get_mesh(2),
+                                engine="tiled", bucket_size=64,
+                                max_batch=32, min_batch=16,
+                                id_offset=b, emit="candidates")
+        eng.warmup()
+        srv = HostSliceServer(("127.0.0.1", 0), eng, routing="bounds")
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        srv.ready = True
+        servers.append(srv)
+    urls = [f"http://127.0.0.1:{s.server_address[1]}" for s in servers]
+    yield urls, points
+    for s in servers:
+        s.close()
+
+
+@pytest.fixture(scope="module")
+def reference_engine():
+    """One engine over the union of the slabs — PR-5's byte-identical
+    stand-in for the replicate-everything pod."""
+    from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+    from mpi_cuda_largescaleknn_tpu.serve.engine import ResidentKnnEngine
+
+    eng = ResidentKnnEngine(_routed_points(), K, mesh=get_mesh(2),
+                            engine="tiled", bucket_size=64,
+                            max_batch=32, min_batch=16, merge="device")
+    eng.warmup()
+    return eng
+
+
+@pytest.fixture(scope="module")
+def frontend(routed_pod):
+    from mpi_cuda_largescaleknn_tpu.serve.frontend import build_frontend
+
+    urls, _ = routed_pod
+    srv = build_frontend(urls, port=0, pipeline_depth=2)  # routing=auto
+    srv.ready = True
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield srv
+    srv.close()
+
+
+class TestBoundsTable:
+    def _table(self):
+        from mpi_cuda_largescaleknn_tpu.serve.frontend import PodBoundsTable
+
+        return PodBoundsTable([
+            {"row_offset": 0, "n_points": 10, "shards": [
+                {"lo": [0.0, 0.0, 0.0], "hi": [1.0, 1.0, 1.0], "count": 8},
+                {"lo": None, "hi": None, "count": 0},  # empty-shard sentinel
+                {"lo": [2.0, 2.0, 2.0], "hi": [3.0, 3.0, 3.0], "count": 2},
+            ]},
+            {"row_offset": 10, "n_points": 0, "shards": [
+                {"lo": None, "hi": None, "count": 0},
+            ]},
+        ], dim=3)
+
+    def test_lower_bounds_math(self):
+        t = self._table()
+        q = np.array([[0.5, 0.5, 0.5],    # inside shard 0's box
+                      [1.5, 0.5, 0.5],    # 0.5 off one face
+                      [4.0, 4.0, 4.0]],   # nearest is shard 2's corner
+                     np.float32)
+        lb = t.lower_bounds(q)
+        assert lb.shape == (3, 2)
+        np.testing.assert_allclose(lb[:, 0], [0.0, 0.25, 3.0], atol=1e-12)
+        # host 1 has no points anywhere: unreachable, never routed
+        assert np.all(np.isinf(lb[:, 1]))
+
+    def test_empty_host_never_nearest(self):
+        t = self._table()
+        lb = t.lower_bounds(np.zeros((4, 3), np.float32))
+        assert np.all(np.argmin(lb, axis=1) == 0)
+
+    def test_malformed_nonempty_shard_without_box_raises(self):
+        from mpi_cuda_largescaleknn_tpu.serve.frontend import PodBoundsTable
+
+        with pytest.raises(ValueError, match="malformed"):
+            PodBoundsTable([{"row_offset": 0, "n_points": 3, "shards": [
+                {"lo": None, "hi": None, "count": 3}]}], dim=3)
+
+    def test_aabb_lower_bound_is_a_true_bound(self):
+        from mpi_cuda_largescaleknn_tpu.utils.math import (
+            aabb_lower_bound_dist2,
+        )
+        from tests.oracle import pairwise_dist2_np, random_points
+
+        pts = random_points(64, seed=7)
+        q = random_points(16, seed=8, scale=2.0)
+        lo = pts.min(axis=0, keepdims=True)
+        hi = pts.max(axis=0, keepdims=True)
+        lb = aabb_lower_bound_dist2(q, lo, hi)[:, 0]
+        d2 = pairwise_dist2_np(q, pts).min(axis=1)
+        assert np.all(lb <= d2 + 1e-12)
+
+
+class TestRoutedServedBitIdentical:
+    def test_ragged_batches_match_reference(self, frontend, routed_pod,
+                                            reference_engine):
+        """Mixed A/B/duplicate queries at every shape bucket: distances
+        AND tie ids byte-equal to the one-engine reference, and true
+        k-NN against the numpy oracle."""
+        _urls, points = routed_pod
+        base = f"http://127.0.0.1:{frontend.server_address[1]}"
+        from tests.oracle import kth_nn_dist, random_points
+
+        for n in (1, 5, 16, 17, 32):
+            q = random_points(n, seed=300 + n)  # spans A, B, and the gap
+            q[: n // 3] = points[295: 295 + n // 3]  # ON cross-host dups
+            resp = _post_knn(base, q)
+            want_d, want_n = reference_engine.query(q)
+            got_d = np.asarray(resp["dists"], np.float32)
+            got_n = np.asarray(resp["neighbors"], np.int32)
+            np.testing.assert_array_equal(got_d, want_d)
+            np.testing.assert_array_equal(got_n, want_n)
+            np.testing.assert_allclose(got_d, kth_nn_dist(q, points, K),
+                                       rtol=5e-7, atol=1e-37)
+
+    def test_clustered_and_uniform_workloads_match(self, frontend,
+                                                   routed_pod,
+                                                   reference_engine):
+        rng = np.random.default_rng(77)
+        base = f"http://127.0.0.1:{frontend.server_address[1]}"
+        batches = [
+            (rng.random((24, 3)) * 0.4).astype(np.float32),          # A blob
+            (rng.random((24, 3)) * 0.4 + 0.6).astype(np.float32),    # B blob
+            rng.random((32, 3)).astype(np.float32),                  # uniform
+        ]
+        for q in batches:
+            resp = _post_knn(base, q)
+            want_d, want_n = reference_engine.query(q)
+            np.testing.assert_array_equal(
+                np.asarray(resp["dists"], np.float32), want_d)
+            np.testing.assert_array_equal(
+                np.asarray(resp["neighbors"], np.int32), want_n)
+
+    def test_escalation_wave_forced_and_certification(self, frontend,
+                                                      routed_pod,
+                                                      reference_engine):
+        """Gap queries sit INSIDE host 0's outlier-widened box (lb 0 —
+        wave 1 goes there) but OUTSIDE host 1's; host 1's small positive
+        bound still beats their wave-1 k-th distance, so they MUST
+        escalate for correctness. A-region queries must certify after one
+        host. Both visible in the fan-out's routing accounting, results
+        exact throughout."""
+        _urls, points = routed_pod
+        base = f"http://127.0.0.1:{frontend.server_address[1]}"
+        fan = frontend.fanout
+        esc_before = fan.escalations
+
+        rng = np.random.default_rng(88)      # gap queries in [0.5, 0.58]^3
+        qb = (0.5 + 0.08 * rng.random((24, 3))).astype(np.float32)
+        resp = _post_knn(base, qb)
+        want_d, want_n = reference_engine.query(qb)
+        np.testing.assert_array_equal(
+            np.asarray(resp["dists"], np.float32), want_d)
+        np.testing.assert_array_equal(
+            np.asarray(resp["neighbors"], np.int32), want_n)
+        assert fan.escalations > esc_before  # the second wave really ran
+
+        qa = points[10:34].copy()            # deep-A queries
+        resp = _post_knn(base, qa)
+        want_d, want_n = reference_engine.query(qa)
+        np.testing.assert_array_equal(
+            np.asarray(resp["dists"], np.float32), want_d)
+        np.testing.assert_array_equal(
+            np.asarray(resp["neighbors"], np.int32), want_n)
+        hpq = fan.stats()["routing"]["hosts_per_query"]
+        assert "1" in hpq and "2" in hpq  # some certified at one host
+
+    def test_concurrent_clients_through_pipelined_fanout(self, frontend,
+                                                         reference_engine):
+        from tests.oracle import random_points
+
+        base = f"http://127.0.0.1:{frontend.server_address[1]}"
+        results = {}
+
+        def client(i):
+            q = random_points(3 + 2 * i, seed=900 + i)
+            results[i] = (q, _post_knn(base, q))
+
+        ths = [threading.Thread(target=client, args=(i,)) for i in range(6)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        assert len(results) == 6
+        for q, resp in results.values():
+            want_d, want_n = reference_engine.query(q)
+            np.testing.assert_array_equal(
+                np.asarray(resp["dists"], np.float32), want_d)
+            np.testing.assert_array_equal(
+                np.asarray(resp["neighbors"], np.int32), want_n)
+
+    def test_mode_detection_and_mismatch(self, routed_pod):
+        from mpi_cuda_largescaleknn_tpu.serve.frontend import (
+            pod_config_from_hosts,
+        )
+
+        urls, points = routed_pod
+        cfg = pod_config_from_hosts(urls)  # auto
+        assert cfg["routing"] == "bounds"
+        assert cfg["n_points"] == len(points)
+        assert [h["row_offset"] for h in cfg["bounds_hosts"]] == [0, 300]
+        with pytest.raises(ValueError, match="routing='off'"):
+            pod_config_from_hosts(urls, routing="off")
+        # a hole in the slab tiling is a hard error, not a warning
+        with pytest.raises(ValueError, match="tile the index"):
+            pod_config_from_hosts([urls[1]], routing="bounds")
+
+    def test_observability_surface(self, frontend, routed_pod):
+        """Per-shard AABBs + routed-row counters on the hosts; escalation
+        counter, per-host routed rows, and the hosts-per-query histogram
+        on the front end; loadgen's /stats projection carries the routed
+        share + escalation rate."""
+        urls, _ = routed_pod
+        base = f"http://127.0.0.1:{frontend.server_address[1]}"
+        for url in urls:
+            with urllib.request.urlopen(url + "/stats", timeout=30) as r:
+                st = json.loads(r.read())
+            assert st["routing"] == "bounds"
+            sb = st["engine"]["shard_bounds"]
+            assert sum(s["count"] for s in sb) == st["engine"]["n_points"]
+            assert all(s["lo"] is not None for s in sb if s["count"])
+            assert st["server"].get("knn_routed_rows_total", 0) > 0
+            m = urllib.request.urlopen(url + "/metrics",
+                                       timeout=30).read().decode()
+            assert "knn_routed_rows_total" in m
+            assert "knn_host_routed 1" in m
+
+        with urllib.request.urlopen(base + "/stats", timeout=30) as r:
+            stats = json.loads(r.read())
+        routing = stats["fanout"]["routing"]
+        assert routing["mode"] == "bounds"
+        assert routing["escalations"] > 0
+        assert set(routing["routed_rows"]) == set(urls)
+        assert sum(routing["routed_rows"].values()) > 0
+        assert routing["hosts_per_query_mean"] is not None
+        assert "complete_seconds_total" in stats["batcher"]
+
+        m = urllib.request.urlopen(base + "/metrics",
+                                   timeout=30).read().decode()
+        assert "knn_routing_escalations_total" in m
+        for url in urls:
+            assert f'knn_routed_rows_total{{host="{url}"}}' in m
+        assert 'knn_hosts_per_query_bucket{le="+Inf"}' in m
+
+        from tools.loadgen import _server_pipeline_stats
+
+        proj = _server_pipeline_stats(base, 30.0)
+        assert proj["routing_mode"] == "bounds"
+        assert proj["routing_escalations"] > 0
+        assert abs(sum(proj["routed_row_share"].values()) - 1.0) < 1e-6
+        assert proj["hosts_per_query_mean"] >= 1.0
+
+
+class TestRoutedSingleHost:
+    def test_h1_pod_matches_reference(self, reference_engine):
+        """H=1 routed pod: one slab host owning everything — routing is
+        the identity, results still byte-equal."""
+        from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+        from mpi_cuda_largescaleknn_tpu.serve.engine import ResidentKnnEngine
+        from mpi_cuda_largescaleknn_tpu.serve.frontend import (
+            HostSliceServer,
+            build_frontend,
+        )
+        from tests.oracle import random_points
+
+        points = _routed_points()
+        eng = ResidentKnnEngine(points, K, mesh=get_mesh(2),
+                                engine="tiled", bucket_size=64,
+                                max_batch=32, min_batch=16,
+                                emit="candidates")
+        host = HostSliceServer(("127.0.0.1", 0), eng, routing="bounds")
+        threading.Thread(target=host.serve_forever, daemon=True).start()
+        host.ready = True
+        fe = None
+        try:
+            url = f"http://127.0.0.1:{host.server_address[1]}"
+            fe = build_frontend([url], port=0, pipeline_depth=2)
+            fe.ready = True
+            threading.Thread(target=fe.serve_forever, daemon=True).start()
+            base = f"http://127.0.0.1:{fe.server_address[1]}"
+            q = random_points(17, seed=5)
+            q[:4] = points[295:299]
+            resp = _post_knn(base, q)
+            want_d, want_n = reference_engine.query(q)
+            np.testing.assert_array_equal(
+                np.asarray(resp["dists"], np.float32), want_d)
+            np.testing.assert_array_equal(
+                np.asarray(resp["neighbors"], np.int32), want_n)
+        finally:
+            if fe is not None:
+                fe.close()
+            host.close()
+
+
+class TestRadiusAndFoldDiscipline:
+    """The fold itself (no HTTP): radius-capped + under-full rows keep the
+    engines' strict-< adoption through the cross-host merge."""
+
+    def _slab_engines(self, points, max_radius=np.inf):
+        from mpi_cuda_largescaleknn_tpu.models.sharding import slab_bounds
+        from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+        from mpi_cuda_largescaleknn_tpu.serve.engine import ResidentKnnEngine
+
+        return [ResidentKnnEngine(points[b:e], K, mesh=get_mesh(1),
+                                  engine="tiled", bucket_size=32,
+                                  max_batch=8, min_batch=8,
+                                  max_radius=max_radius,
+                                  id_offset=b, emit="candidates")
+                for b, e in slab_bounds(len(points), 2)]
+
+    def _fold_all(self, engines, q):
+        from mpi_cuda_largescaleknn_tpu.serve.frontend import (
+            _fold_candidates,
+        )
+
+        cur_d2 = np.full((len(q), K), np.inf, np.float32)
+        cur_idx = np.full((len(q), K), -1, np.int32)
+        rows = np.arange(len(q))
+        for eng in engines:
+            d2, idx = eng.complete_candidates(eng.dispatch(q))
+            _fold_candidates(cur_d2, cur_idx, rows, d2, idx, K)
+        return np.sqrt(cur_d2[:, K - 1]), cur_idx
+
+    def test_radius_capped_and_underfull_rows(self):
+        from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+        from mpi_cuda_largescaleknn_tpu.serve.engine import ResidentKnnEngine
+        from tests.oracle import random_points
+
+        points = random_points(40, seed=11)
+        r = 0.15  # caps most rows under k=5 candidates
+        ref = ResidentKnnEngine(points, K, mesh=get_mesh(1),
+                                engine="tiled", bucket_size=32,
+                                max_batch=8, min_batch=8, max_radius=r)
+        q = random_points(8, seed=12)
+        want_d, want_n = ref.query(q)
+        got_d, got_n = self._fold_all(self._slab_engines(points, r), q)
+        assert np.any(want_n == -1)  # the cap really bit
+        np.testing.assert_array_equal(got_d, want_d)
+        np.testing.assert_array_equal(got_n, want_n)
+
+    def test_fold_is_wave_order_independent(self):
+        from tests.oracle import random_points
+
+        points = random_points(40, seed=13)
+        engines = self._slab_engines(points)
+        q = random_points(8, seed=14)
+        q[:2] = points[35:37]  # ids on host 1, ties vs nothing on host 0
+        d_fwd, n_fwd = self._fold_all(engines, q)
+        d_rev, n_rev = self._fold_all(engines[::-1], q)
+        np.testing.assert_array_equal(d_fwd, d_rev)
+        np.testing.assert_array_equal(n_fwd, n_rev)
+
+    def test_host_merge_candidate_rows_match_device_merge(self):
+        """A routed host may run either merge placement locally; the full
+        candidate rows it serves must be identical — the host-merge path
+        rides the full-width variant of the PR-3 numpy fold."""
+        from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+        from mpi_cuda_largescaleknn_tpu.serve.engine import ResidentKnnEngine
+        from tests.oracle import random_points
+
+        points = random_points(64, seed=15)
+        twins = [ResidentKnnEngine(points, K, mesh=get_mesh(2),
+                                   engine="tiled", bucket_size=32,
+                                   max_batch=8, min_batch=8, merge=m,
+                                   emit="candidates")
+                 for m in ("host", "device")]
+        q = random_points(8, seed=16)
+        q[:3] = points[10:13]  # exact hits -> boundary ties
+        outs = [e.complete_candidates(e.dispatch(q)) for e in twins]
+        np.testing.assert_array_equal(outs[0][0], outs[1][0])
+        np.testing.assert_array_equal(outs[0][1], outs[1][1])
+        # ascending canonical rows, -1 only in under-full slots
+        assert np.all(np.diff(outs[0][0], axis=1) >= 0)

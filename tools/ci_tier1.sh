@@ -24,8 +24,14 @@ if [ "${SERVE_BENCH:-1}" != "0" ] && [ "$rc" -ne 124 ]; then
   # --kernel-bench adds the distance-kernel section (kernel_compare):
   # elementwise VPU vs MXU matmul-form scoring at D in {3, 8, 64},
   # gated on MXU-vs-VPU bitwise exactness; speedups are trajectory data
-  timeout -k 10 1800 python tools/serve_smoke.py --duration 2 --trials 3 \
-      --locality-bench --multihost-bench --kernel-bench \
+  # --routing-bench adds the shard-local routing section
+  # (routing_compare): the 2-host pod at --routing bounds vs --routing
+  # off on clustered + uniform workloads — gated on the probe batch
+  # being bitwise identical between the two (tie ids included) and
+  # oracle-exact; multihost_compare additionally gates on its
+  # qps_ratio_pod_vs_single regression floor
+  timeout -k 10 2400 python tools/serve_smoke.py --duration 2 --trials 3 \
+      --locality-bench --multihost-bench --kernel-bench --routing-bench \
       --out BENCH_serve.json >/dev/null || { brc=$?; [ "$rc" -eq 0 ] && rc=$brc; }
 fi
 exit $rc

@@ -141,6 +141,32 @@ def _server_pipeline_stats(url: str, timeout_s: float) -> dict | None:
         "sort_queries": stats.get("engine", {}).get("sort_queries"),
         "tiles_executed": stats.get("engine", {}).get("tiles_executed"),
         "tiles_skipped": stats.get("engine", {}).get("tiles_skipped"),
+        # shard-local routing surface (pod front ends with
+        # --routing bounds): routed-row share per host — clustered traffic
+        # skews it toward the hosts owning the hot regions — plus the
+        # escalation rate and mean hosts visited per query, so one loadgen
+        # run shows clustered-vs-uniform routing behavior end to end
+        **_routing_projection(stats),
+    }
+
+
+def _routing_projection(stats: dict) -> dict:
+    routing = stats.get("fanout", {}).get("routing")
+    if not routing:
+        return {}
+    rr = routing.get("routed_rows", {})
+    total = sum(rr.values())
+    rows_served = stats.get("batcher", {}).get("rows_served", 0)
+    return {
+        "routing_mode": routing.get("mode"),
+        "routing_escalations": routing.get("escalations"),
+        "routing_escalation_rate": (
+            round(routing.get("escalations", 0) / rows_served, 4)
+            if rows_served else None),
+        "routed_rows": rr,
+        "routed_row_share": {u: round(v / total, 4) for u, v in rr.items()}
+        if total else {},
+        "hosts_per_query_mean": routing.get("hosts_per_query_mean"),
     }
 
 

@@ -6,9 +6,13 @@ query_buckets 1 vs auto, gated on deterministic tile-skip accounting
 (``locality_compare`` in BENCH_serve.json), plus (``--multihost-bench``)
 the pod-serving comparison — 2 simulated host processes over one global
 mesh + the fan-out front end vs a single-process server of the same
-config, gated on oracle-exactness with the deterministic
-fetched-bytes-per-pod ratio as the headline (``multihost_compare``;
-tools/ci_tier1.sh passes both flags).
+config, gated on oracle-exactness AND a q/s regression floor, with the
+deterministic fetched-bytes-per-pod ratio as the headline
+(``multihost_compare``), plus (``--routing-bench``) the shard-local
+routing comparison — the same 2-host pod at ``--routing bounds`` vs
+``--routing off`` on clustered and uniform workloads, gated on the probe
+batch being BITWISE identical between the two (tie ids included) and
+oracle-exact (``routing_compare``; tools/ci_tier1.sh passes all flags).
 
 Boots the full serving stack in-process on a CPU fixture (default: one
 virtual device, single-threaded Eigen, tiled engine — one core per
@@ -78,6 +82,29 @@ def _setup_cpu_fixture(devices: int) -> None:
 
 
 import numpy as np  # noqa: E402
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _pod_env() -> dict:
+    """Env for child serve_main processes: they pin their own device
+    counts, so this process's fixture flags must not leak in."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+        and "xla_cpu_multi_thread_eigen" not in f).strip()
+    return env
 
 
 def _run_loadgen(base_url, *, duration_s, concurrency, batch, seed,
@@ -431,29 +458,13 @@ def run_multihost_bench(*, n_points=8192, k=16, hosts=2, duration_s=2.0,
             srv.close()
 
     # --- pod: one serve_main process per host, 1 device each, one global
-    # mesh (jax.distributed over gloo) — each grandchild pins its own
-    # device count, so this process's fixture flags must not leak in
-    import socket
-
-    def free_port():
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            return s.getsockname()[1]
-
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["XLA_FLAGS"] = " ".join(
-        f for f in env.get("XLA_FLAGS", "").split()
-        if "xla_force_host_platform_device_count" not in f
-        and "xla_cpu_multi_thread_eigen" not in f).strip()
+    # mesh (jax.distributed over gloo)
+    env = _pod_env()
     with tempfile.NamedTemporaryFile(suffix=".float3", delete=False) as f:
         pts_path = f.name
     points.tofile(pts_path)
-    coord = free_port()
-    ports = [free_port() for _ in range(hosts)]
+    coord = _free_port()
+    ports = [_free_port() for _ in range(hosts)]
     urls = [f"http://127.0.0.1:{p}" for p in ports]
     base_cmd = [sys.executable, "-m",
                 "mpi_cuda_largescaleknn_tpu.cli.serve_main",
@@ -520,6 +531,12 @@ def run_multihost_bench(*, n_points=8192, k=16, hosts=2, duration_s=2.0,
         # single-process result bytes; the pod-mesh merge pays ~1 x
         out["fetch_ratio_per_host_fetch_vs_pod"] = round(
             hosts * single_per_row / max(pod_per_row, 1e-9), 2)
+        # regression FLOOR on the pod-vs-single q/s ratio: the
+        # replicate-everything pod legitimately trails one process on this
+        # co-located CPU fixture (gloo collectives + doubled traversal),
+        # but a collapse below 0.5 means the fan-out itself broke — that
+        # gates, shared-box noise above the floor does not
+        out["qps_ratio_floor"] = 0.5
         out["per_host_engines"] = [
             {"process_index": e["process_index"],
              "my_positions": e["my_positions"],
@@ -531,6 +548,8 @@ def run_multihost_bench(*, n_points=8192, k=16, hosts=2, duration_s=2.0,
         if out["single"]["qps"]:
             out["qps_ratio_pod_vs_single"] = round(
                 out["pod"]["qps"] / out["single"]["qps"], 3)
+            out["qps_ratio_ok"] = (out["qps_ratio_pod_vs_single"]
+                                   >= out["qps_ratio_floor"])
         return out
     finally:
         if fe is not None:
@@ -543,6 +562,201 @@ def run_multihost_bench(*, n_points=8192, k=16, hosts=2, duration_s=2.0,
             except subprocess.TimeoutExpired:
                 p.kill()
         os.unlink(pts_path)
+
+
+def run_routing_bench(*, n_points=32768, k=64, hosts=2, duration_s=2.0,
+                      concurrency=12, batch=32, max_batch=128,
+                      max_delay_s=0.008, blobs=8, blob_sigma=0.02,
+                      trials=2, seed=0) -> dict:
+    """Shard-local routing (``--routing bounds``) vs the replicate-
+    everything pod (``--routing off``) on clustered AND uniform workloads:
+    the same 2 host processes + front end either replicate every batch
+    pod-wide (global-mesh collectives) or serve routed slab sub-batches.
+
+    The index file is Morton-sorted — the io partitioner's production
+    order — so the row slabs are spatially tight boxes and the bounds
+    table can actually prune; a handful of rows are duplicated ACROSS the
+    slab boundary so the bitwise probe exercises cross-host distance-0
+    ties. The probe batch (clustered + uniform + on-duplicate queries,
+    with neighbor ids) must be BIT-IDENTICAL between the two configs and
+    oracle-exact — that gates the exit code; the q/s ratios are the
+    headline trajectory numbers (clustered should clear ~1.5 x: most
+    queries certify after one host, so each host traverses a fraction of
+    the rows and no gloo collective runs at all; uniform should hold
+    ~0.9 x: same total traversal work, minus collectives, plus an
+    escalation round trip).
+
+    Fixture shape matters on the 2-core CI box: the default is LARGER
+    (32k points) and DEEPER (k=64) than the other serving benches, and the
+    per-request batch is small (32) — at 8k/k=16 both configs saturate the
+    HTTP/client transport ceiling (clustered traffic is already tile-skip
+    cheap after PR 4, so there is no traversal left to route away), and a
+    one-blob-per-request batch of 64+ rows routes as one lump to one host
+    (imbalance eats the win). 32k x k=64 keeps the traversal compute-bound
+    even under the per-bucket prune, and 32-row requests coalesce into
+    mixed-blob pod batches whose sub-batches balance. BOTH pods stay
+    resident and the trials interleave (the other benches' shared-box
+    discipline) — sequential config runs were noise-dominated.
+    """
+    _setup_cpu_fixture(1)  # this process only runs HTTP + numpy folds
+    from mpi_cuda_largescaleknn_tpu.serve.frontend import (
+        build_frontend,
+        wait_hosts_ready,
+    )
+    from mpi_cuda_largescaleknn_tpu.utils.math import morton_argsort
+    from tests.oracle import kth_nn_dist
+
+    rng = np.random.default_rng(seed)
+    pts = rng.random((n_points, 3)).astype(np.float32)
+    pts = pts[morton_argsort(pts, pts.min(0), pts.max(0))]
+    # duplicate 4 rows across the slab boundary: exact coordinate copies
+    # with different global ids — the tie probe's cross-host targets
+    # (adjacent in Morton order, so the slab boxes barely widen)
+    half = n_points // hosts
+    pts[half:half + 4] = pts[half - 4:half]
+    with tempfile.NamedTemporaryFile(suffix=".float3", delete=False) as f:
+        pts_path = f.name
+    pts.tofile(pts_path)
+
+    # fixed probe: on-duplicate (tie ids), clustered, and uniform rows
+    prng = np.random.default_rng(seed + 1)
+    centers = prng.random((blobs, 3))
+    q_probe = np.concatenate([
+        pts[half - 4:half + 4],
+        np.clip(centers[prng.integers(blobs, size=28)]
+                + prng.normal(0, blob_sigma, (28, 3)), 0, 1),
+        prng.random((28, 3)),
+    ]).astype(np.float32)
+
+    env = _pod_env()
+
+    def probe(base_url):
+        body = json.dumps({"queries": q_probe.tolist(),
+                           "neighbors": True}).encode()
+        req = urllib.request.Request(
+            base_url + "/knn", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            obj = json.loads(resp.read())
+        return (np.asarray(obj["dists"], np.float32),
+                np.asarray(obj["neighbors"], np.int32))
+
+    def boot(routing: str) -> dict:
+        ports = [_free_port() for _ in range(hosts)]
+        urls = [f"http://127.0.0.1:{p}" for p in ports]
+        base_cmd = [sys.executable, "-m",
+                    "mpi_cuda_largescaleknn_tpu.cli.serve_main",
+                    pts_path, "-k", str(k), "--engine", "tiled",
+                    "--bucket-size", "64", "--max-batch", str(max_batch),
+                    "--min-batch", "16"]
+        if routing == "bounds":
+            base_cmd += ["--routing", "bounds", "--num-hosts", str(hosts)]
+        else:
+            base_cmd += ["--merge", "device",
+                         "--coordinator", f"127.0.0.1:{_free_port()}",
+                         "--num-hosts", str(hosts)]
+        procs = [subprocess.Popen(
+            base_cmd + ["--host-id", str(i), "--port", str(ports[i])],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+            text=True) for i in range(hosts)]
+        return {"procs": procs, "urls": urls, "fe": None}
+
+    def teardown(pod):
+        if pod.get("fe") is not None:
+            pod["fe"].close()
+        for p in pod["procs"]:
+            p.terminate()
+        for p in pod["procs"]:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    pods = {}
+    per_config: dict = {}
+    try:
+        # both pods launch up front and STAY resident for the whole run:
+        # the trials interleave across configs, so slow drift on a shared
+        # box lands evenly on both sides of every ratio
+        pods = {"replicate": boot("off"), "routed": boot("bounds")}
+        for name, pod in pods.items():
+            try:
+                wait_hosts_ready(pod["urls"], timeout_s=600.0)
+            except TimeoutError as e:
+                errs = [p.communicate()[1][-500:] if p.poll() is not None
+                        else "<running>" for p in pod["procs"]]
+                return {"kind": "serve_routing_bench", "hosts": hosts,
+                        "error": f"{name}: {e} :: {errs}"}
+            fe = build_frontend(pod["urls"], port=0,
+                                max_delay_s=max_delay_s, pipeline_depth=2)
+            fe.ready = True
+            threading.Thread(target=fe.serve_forever, daemon=True).start()
+            pod["fe"] = fe
+            pod["base"] = f"http://127.0.0.1:{fe.server_address[1]}"
+
+        for name, pod in pods.items():
+            d, nbr = probe(pod["base"])
+            per_config[name] = {
+                "probe_dists": d, "probe_nbrs": nbr,
+                "oracle_exact": bool(np.allclose(
+                    d, kth_nn_dist(q_probe, pts, k),
+                    rtol=5e-7, atol=1e-37))}
+            _run_loadgen(pod["base"], duration_s=duration_s,  # cold burn
+                         concurrency=concurrency, batch=batch,
+                         seed=seed + 99, workload="clustered",
+                         blobs=blobs, blob_sigma=blob_sigma)
+
+        runs = {(name, wl): [] for name in pods
+                for wl in ("clustered", "uniform")}
+        for trial in range(trials):
+            for name, pod in pods.items():
+                for wl in ("clustered", "uniform"):
+                    runs[(name, wl)].append(_run_loadgen(
+                        pod["base"], duration_s=duration_s,
+                        concurrency=concurrency, batch=batch,
+                        seed=seed + trial, workload=wl, blobs=blobs,
+                        blob_sigma=blob_sigma))
+        for (name, wl), reps in runs.items():
+            med = sorted(reps, key=lambda r: r["qps"])[len(reps) // 2]
+            per_config[name][wl] = {
+                "qps": med["qps"], "p99_ms": med["p99_ms"],
+                "qps_trials": [r["qps"] for r in reps]}
+        fan = pods["routed"]["fe"].fanout.stats()
+        per_config["routed"]["routing_stats"] = fan.get("routing")
+    finally:
+        for pod in pods.values():
+            teardown(pod)
+        os.unlink(pts_path)
+
+    out = {
+        "kind": "serve_routing_bench", "hosts": hosts,
+        "n_points": n_points, "k": k, "pipeline_depth": 2,
+        "duration_s": duration_s, "concurrency": concurrency,
+        "batch": batch, "blobs": blobs, "blob_sigma": blob_sigma,
+        "trials": trials,
+        "clustered_target": 1.5, "uniform_floor": 0.9,
+    }
+    rep, rou = per_config["replicate"], per_config["routed"]
+    if "error" in rep or "error" in rou:
+        out["error"] = rep.get("error") or rou.get("error")
+        return out
+    out["bitwise_identical_to_routing_off"] = bool(
+        np.array_equal(rep["probe_dists"], rou["probe_dists"])
+        and np.array_equal(rep["probe_nbrs"], rou["probe_nbrs"]))
+    out["oracle_exact"] = bool(rep["oracle_exact"] and rou["oracle_exact"])
+    for cfg in per_config.values():
+        cfg.pop("probe_dists", None)
+        cfg.pop("probe_nbrs", None)
+    out["per_config"] = per_config
+    for wl in ("clustered", "uniform"):
+        if rep[wl]["qps"]:
+            out[f"qps_ratio_{wl}"] = round(rou[wl]["qps"]
+                                           / rep[wl]["qps"], 3)
+    out["clustered_ok"] = (out.get("qps_ratio_clustered", 0)
+                           >= out["clustered_target"])
+    out["uniform_ok"] = (out.get("qps_ratio_uniform", 0)
+                         >= out["uniform_floor"])
+    return out
 
 
 def run_kernel_bench(*, dims=(3, 8, 64), n_points=8192, n_queries=1024,
@@ -676,6 +890,15 @@ def main(argv=None) -> int:
                     help="internal: run ONLY the multi-host bench in this "
                          "process (needs its own 2-device fixture for the "
                          "single-process twin) and print its JSON")
+    ap.add_argument("--routing-bench", action="store_true",
+                    help="also run the shard-local routing bench (2-host "
+                         "pod at --routing bounds vs --routing off on "
+                         "clustered + uniform workloads, bitwise-parity "
+                         "probe) in a subprocess and embed routing_compare")
+    ap.add_argument("--routing-child", action="store_true",
+                    help="internal: run ONLY the routing bench in this "
+                         "process (spawns its own pod processes) and "
+                         "print its JSON")
     ap.add_argument("--kernel-bench", action="store_true",
                     help="also run the distance-kernel bench (elementwise "
                          "VPU vs MXU matmul-form at D in {3, 8, 64}) in a "
@@ -690,6 +913,19 @@ def main(argv=None) -> int:
         report = run_kernel_bench(n_points=a.points, k=a.k, seed=a.seed)
         print(json.dumps(report, indent=2))
         return 0 if report.get("exact_bitwise") else 1
+
+    if a.routing_child:
+        # the routing bench pins its OWN fixture shape (32k points, k=64,
+        # 32-row requests — see run_routing_bench: at the default smoke
+        # fixture both configs are transport-bound and the ratio measures
+        # nothing); only the timing knobs ride through
+        report = run_routing_bench(
+            duration_s=a.duration, trials=max(1, a.trials - 1),
+            max_delay_s=a.max_delay_ms / 1e3, seed=a.seed)
+        print(json.dumps(report, indent=2))
+        return 0 if (report.get("oracle_exact")
+                     and report.get("bitwise_identical_to_routing_off")) \
+            else 1
 
     if a.multihost_child:
         report = run_multihost_bench(
@@ -845,7 +1081,10 @@ def main(argv=None) -> int:
             mh = json.loads(child.stdout)
             report["multihost_compare"] = mh
             if "error" not in mh:  # infra hiccups degrade, never gate
-                ok = ok and bool(mh.get("oracle_exact"))
+                # exactness AND the q/s regression floor both gate: a pod
+                # serving below half a single host means the fan-out broke
+                ok = (ok and bool(mh.get("oracle_exact"))
+                      and bool(mh.get("qps_ratio_ok", True)))
         except (subprocess.TimeoutExpired, json.JSONDecodeError) as e:
             if isinstance(e, json.JSONDecodeError):
                 detail = (child.stderr or child.stdout or "")[-1500:]
@@ -854,6 +1093,39 @@ def main(argv=None) -> int:
                 detail = (raw.decode(errors="replace")
                           if isinstance(raw, bytes) else str(raw))[-1500:]
             report["multihost_compare"] = {
+                "error": f"{str(e)[:300]} :: {detail}"}
+    if a.routing_bench:
+        # same subprocess discipline: the routing child spawns its own pod
+        # processes (replicate-everything twin AND routed twin) and probes
+        # them with one fixed batch. Bitwise parity (incl. tie ids) and
+        # oracle-exactness gate the exit; the clustered/uniform q/s ratios
+        # are the headline trajectory numbers (clustered_target 1.5 x,
+        # uniform_floor 0.9 x recorded alongside)
+        try:
+            child = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--routing-child",
+                 "--points", str(a.points), "--k", str(a.k),
+                 "--duration", str(a.duration),
+                 "--concurrency", str(a.concurrency),
+                 "--batch", str(a.batch), "--trials", str(a.trials),
+                 "--max-delay-ms", str(a.max_delay_ms),
+                 "--seed", str(a.seed)],
+                capture_output=True, text=True, env=env,
+                timeout=900 + a.duration * (a.trials + 2) * 10)
+            rc_ = json.loads(child.stdout)
+            report["routing_compare"] = rc_
+            if "error" not in rc_:  # infra hiccups degrade, never gate
+                ok = (ok and bool(rc_.get("oracle_exact"))
+                      and bool(rc_.get("bitwise_identical_to_routing_off")))
+        except (subprocess.TimeoutExpired, json.JSONDecodeError) as e:
+            if isinstance(e, json.JSONDecodeError):
+                detail = (child.stderr or child.stdout or "")[-1500:]
+            else:
+                raw = e.stderr or e.stdout or b""
+                detail = (raw.decode(errors="replace")
+                          if isinstance(raw, bytes) else str(raw))[-1500:]
+            report["routing_compare"] = {
                 "error": f"{str(e)[:300]} :: {detail}"}
     text = json.dumps(report, indent=2)
     print(text)
