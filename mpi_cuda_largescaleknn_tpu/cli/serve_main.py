@@ -55,6 +55,12 @@ SERVE_FLAGS = """
                     previous batch's host merge; 1 = fully serialized)
   --max-queue-rows N  admission cap on queued+running rows (default 4096)
   --timeout-ms F    default per-request deadline (default 5000)
+  --seq-timeout-s F how long a pod host waits for its turn in the
+                    /shard_knn sequence order before answering 503 +
+                    Retry-After (default 120; replicate mode only — a
+                    lower seq that never arrives means the pod stream is
+                    stalled). Fault injection for failure drills rides the
+                    KNN_FAULTS env var / POST /faults (serve/faults.py)
   --no-warmup       skip compiling all shape buckets before serving
                     (first request per bucket then pays the compile)
   --timings         print engine phase timings as JSON on shutdown
@@ -94,7 +100,7 @@ def parse_serve_args(argv: list[str]) -> dict:
            "bucket_size": 0, "query_buckets": 0,
            "max_batch": 1024, "min_batch": 8,
            "max_delay_ms": 2.0, "pipeline_depth": 2,
-           "max_queue_rows": 4096,
+           "max_queue_rows": 4096, "seq_timeout_s": None,
            "timeout_ms": 5000.0, "warmup": True, "timings": False,
            "verbose": False,
            "coordinator": None, "num_hosts": 1, "host_id": 0,
@@ -137,6 +143,8 @@ def parse_serve_args(argv: list[str]) -> dict:
                 i += 1; opt["max_queue_rows"] = int(argv[i])
             elif arg == "--timeout-ms":
                 i += 1; opt["timeout_ms"] = float(argv[i])
+            elif arg == "--seq-timeout-s":
+                i += 1; opt["seq_timeout_s"] = float(argv[i])
             elif arg == "--coordinator":
                 i += 1; opt["coordinator"] = argv[i]
             elif arg == "--num-hosts":
@@ -245,6 +253,7 @@ def main(argv: list[str] | None = None) -> int:
 
         server = HostSliceServer((opt["host"], opt["port"]), engine,
                                  routing=opt["routing"],
+                                 seq_timeout_s=opt["seq_timeout_s"],
                                  verbose=opt["verbose"])
         try:
             if opt["warmup"]:

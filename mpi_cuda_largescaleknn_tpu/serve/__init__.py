@@ -28,6 +28,15 @@ amortizes it across a request stream:
                  assembles per-host row slices, and re-exposes the same
                  public contract with per-host health and straggler
                  accounting.
+- ``health``   — the fault-tolerance supervisor: per-host
+                 healthy/suspect/drained/rejoining lifecycle, capped
+                 exponential backoff with deterministic jitter, and the
+                 background monitor that drains failing hosts and gates
+                 rejoin on a config/bounds fingerprint match.
+- ``faults``   — deterministic fault injection (seeded latency / error /
+                 drop / close-mid-body injectors on every serving
+                 handler; ``KNN_FAULTS`` env or POST /faults) so every
+                 failure path is testable without real process kills.
 
 TPU-KNN (arXiv:2206.14286) reaches peak FLOP/s only with large fixed-shape
 query batches; PANDA (arXiv:1607.08220) frames distributed kNN as a

@@ -247,10 +247,15 @@ class DynamicBatcher:
         return live
 
     @staticmethod
-    def _deliver(live: list[_Request], dists, nbrs) -> None:
+    def _deliver(live: list[_Request], outs: tuple) -> None:
+        """Offset-demux every array of the result tuple per request. The
+        tuple is ``(dists, neighbors)`` for engines and the replicate pod,
+        ``(dists, neighbors, exact)`` for routed fan-outs (the per-row
+        exactness mask under degraded serving) — the demux is shape-generic
+        so a new result column never touches this code again."""
         off = 0
         for r in live:
-            r.result = (dists[off:off + r.rows], nbrs[off:off + r.rows])
+            r.result = tuple(a[off:off + r.rows] for a in outs)
             off += r.rows
             r.done.set()
 
@@ -274,11 +279,11 @@ class DynamicBatcher:
                 t0 = time.perf_counter()
                 merged = (live[0].queries if len(live) == 1 else
                           np.concatenate([r.queries for r in live]))
-                dists, nbrs = self._query_fn(merged)
+                outs = self._query_fn(merged)
                 if self._timers is not None:
                     self._timers.hist("batch_exec_seconds").record(
                         time.perf_counter() - t0)
-                self._deliver(live, dists, nbrs)
+                self._deliver(live, outs)
                 with self._cond:
                     self.rows_served += len(merged)
             except Exception as e:  # noqa: BLE001 - delivered per request
@@ -371,12 +376,12 @@ class DynamicBatcher:
             live, rows, handle, t0 = item
             try:
                 tc = time.perf_counter()
-                dists, nbrs = self._query_fn.complete(handle)
+                outs = self._query_fn.complete(handle)
                 self.complete_hist.record(time.perf_counter() - tc)
                 if self._timers is not None:
                     self._timers.hist("batch_exec_seconds").record(
                         time.perf_counter() - t0)
-                self._deliver(live, dists, nbrs)
+                self._deliver(live, outs)
                 with self._cond:
                     self.rows_served += rows
             except Exception as e:  # noqa: BLE001 - delivered per request

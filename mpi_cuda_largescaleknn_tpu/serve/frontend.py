@@ -33,11 +33,27 @@ IDENTICAL batches in the SAME order. That is the front end's contract:
   per-host health and straggler accounting (per-batch spread between the
   first and last host slice to land).
 
-Failure semantics: the pod is one SPMD machine. If any host fails or
-drops a sequence number, in-flight collectives cannot complete and the
-front end marks the pod broken (``/healthz`` -> 503, requests -> 500)
-rather than serving partial answers; restart the host processes together
-(docs/SERVING.md "Multi-host serving").
+Failure semantics (docs/SERVING.md "Failure handling & degraded mode"):
+each host is supervised through a ``healthy -> suspect -> drained ->
+rejoining`` lifecycle (serve/health.py) fed by both dispatch outcomes and
+the background ``HealthMonitor``'s /healthz probes. Routed mode: dispatch
+retries transient per-host failures (connect errors, timeouts, 5xx) with
+capped-exponential deterministic backoff; a host that keeps failing is
+DRAINED and the fan-out routes around it. The ``on_host_loss`` policy then
+decides what happens to the queries whose certified routing set touches
+the drained slab: ``fail`` answers them 503 + Retry-After (unaffected
+queries keep serving bit-identical), ``degrade`` serves the fold of the
+surviving hosts' partials — well-defined because the candidate fold is
+commutative — explicitly flagged ``exact: false``. A drained host rejoins
+only after the monitor revalidates its config/bounds fingerprint against
+the pod table captured at startup. Replicate mode (``--routing off``) is
+still one SPMD machine — a lost host slice is not degradable — but gets
+drain-then-fail semantics: the pod is marked broken, requests answer 503
+(not an opaque 500), and when every host probes healthy again with a
+matching fingerprint and a consistent ``next_seq`` the monitor resets the
+sequence stream (the clean restart path). All of it is exercised
+deterministically via serve/faults.py injectors (tests/test_failover.py,
+``serve_smoke --chaos-bench``).
 
 Shard-local routing (``--routing bounds``): the replicate-everything
 fan-out above makes adding hosts add WORK, not capacity — every host
@@ -85,6 +101,13 @@ from mpi_cuda_largescaleknn_tpu.serve.admission import (
     OverloadError,
 )
 from mpi_cuda_largescaleknn_tpu.serve.batcher import DynamicBatcher
+from mpi_cuda_largescaleknn_tpu.serve.faults import FaultInjector
+from mpi_cuda_largescaleknn_tpu.serve.health import (
+    Backoff,
+    HealthMonitor,
+    HostHealth,
+    host_fingerprint,
+)
 from mpi_cuda_largescaleknn_tpu.serve.server import (
     JsonHttpHandler,
     ServingMetrics,
@@ -119,14 +142,25 @@ class HostSliceServer(ThreadingHTTPServer):
 
     daemon_threads = True
     #: how long a handler thread waits for ITS turn in the seq order
-    #: before giving up (a lost lower seq means the pod is wedged anyway)
+    #: before giving up (a lost lower seq means the pod is wedged anyway);
+    #: class attribute = the default for the constructor knob below
     seq_timeout_s = 120.0
 
     def __init__(self, addr, engine, *, routing: str = "off",
+                 seq_timeout_s: float | None = None,
+                 faults: FaultInjector | None = None,
                  verbose: bool = False):
         if routing not in ("off", "bounds"):
             raise ValueError(f"routing must be 'off' or 'bounds', "
                              f"got {routing!r}")
+        if seq_timeout_s is not None:
+            if seq_timeout_s <= 0:
+                raise ValueError(f"seq_timeout_s must be > 0, "
+                                 f"got {seq_timeout_s}")
+            self.seq_timeout_s = float(seq_timeout_s)
+        #: deterministic fault injection (serve/faults.py): programmatic,
+        #: or KNN_FAULTS at start, or POST /faults at runtime
+        self.faults = faults if faults is not None else FaultInjector.from_env()
         if routing == "bounds":
             if getattr(engine, "emit", "final") != "candidates":
                 raise ValueError(
@@ -198,6 +232,12 @@ class _HostHandler(JsonHttpHandler):
     def do_GET(self):
         srv: HostSliceServer = self.server
         path = urlparse(self.path).path
+        if path == "/faults":
+            # the fault admin surface is always exempt from injection
+            self._send_json(200, {"specs": srv.faults.config()})
+            return
+        if self._apply_fault(path):
+            return
         if path == "/healthz":
             body = {"status": "ok" if srv.ready else "warming",
                     "role": ("host-routed" if srv.routing == "bounds"
@@ -241,6 +281,21 @@ class _HostHandler(JsonHttpHandler):
     def do_POST(self):
         srv: HostSliceServer = self.server
         parsed = urlparse(self.path)
+        if parsed.path == "/faults":
+            # runtime fault-spec replacement (chaos bench / tests): body is
+            # {"spec": "<grammar>"}; empty spec clears. Exempt from
+            # injection, so a "dead" host can still be revived
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                obj = json.loads(self.rfile.read(length).decode() or "{}")
+                srv.faults.set_specs(obj.get("spec", ""))
+            except (ValueError, json.JSONDecodeError) as e:
+                self._send_json(400, {"error": str(e)})
+                return
+            self._send_json(200, {"specs": srv.faults.config()})
+            return
+        if self._apply_fault(parsed.path):
+            return
         want = "/route_knn" if srv.routing == "bounds" else "/shard_knn"
         if parsed.path != want:
             self._send_json(404, {
@@ -268,6 +323,14 @@ class _HostHandler(JsonHttpHandler):
                 d2, idx = srv.run_routed(q)
             else:
                 rows, dists, nbrs = srv.run_in_order(seq, q)
+        except TimeoutError as e:
+            # seq-order wait expired: the pod stream is stalled, not this
+            # request's fault — 503 + Retry-After, so a well-behaved
+            # client backs off instead of treating it as a server bug
+            srv.metrics.inc("knn_seq_timeout_total")
+            self._send_json(503, {"error": f"TimeoutError: {e}"},
+                            extra=[("Retry-After", "1")])
+            return
         except Exception as e:  # noqa: BLE001 - the front end retries/fails
             srv.metrics.inc("knn_error_total")
             self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
@@ -295,13 +358,25 @@ class _HostHandler(JsonHttpHandler):
 
 class PodBrokenError(RuntimeError):
     """A host failed mid-stream: the pod's collective program order is
-    unrecoverable without restarting the host processes together."""
+    unrecoverable without restarting the host processes together (the
+    monitor's pod-reset path clears it once they do)."""
+
+
+class HostCallError(RuntimeError):
+    """One HTTP call to one host failed. ``transient`` distinguishes
+    retry-worthy failures (connect errors, timeouts, 5xx, torn payloads)
+    from config errors (4xx) that retrying can never fix."""
+
+    def __init__(self, msg: str, transient: bool = True):
+        super().__init__(msg)
+        self.transient = transient
 
 
 class _HostEndpoint:
-    """Front-end bookkeeping for one host: address pieces + accounting."""
+    """Front-end bookkeeping for one host: address pieces + accounting +
+    the supervised health lifecycle (serve/health.py)."""
 
-    def __init__(self, url: str):
+    def __init__(self, url: str, health_config: dict | None = None):
         self.url = url
         p = urlparse(url if "//" in url else "//" + url)
         self.host = p.hostname or "127.0.0.1"
@@ -311,6 +386,10 @@ class _HostEndpoint:
         self.ok = 0
         self.errors = 0
         self.last_error: str | None = None
+        self.health = HostHealth(**(health_config or {}))
+        self.retries = 0
+        self.probe_errors = 0
+        self.scrape_errors = 0
 
 
 class PodFanout:
@@ -329,14 +408,29 @@ class PodFanout:
 
     def __init__(self, host_urls: list[str], *, k: int, max_batch: int,
                  timeout_s: float = 120.0, timers: PhaseTimers | None = None,
-                 dim: int = 3):
+                 dim: int = 3, retries: int = 2,
+                 retry_backoff_s: float = 0.05,
+                 request_timeout_s: float | None = None,
+                 health_config: dict | None = None):
         if not host_urls:
             raise ValueError("need at least one host URL")
-        self.endpoints = [_HostEndpoint(u) for u in host_urls]
+        self.endpoints = [_HostEndpoint(u, health_config)
+                          for u in host_urls]
         self.k = int(k)
         self.dim = int(dim)
         self.max_batch = int(max_batch)
         self.timeout_s = float(timeout_s)
+        #: per-TRY budget for routed posts (None = the pod-wide timeout_s):
+        #: one slow host burns at most this much of the batch's wall-clock
+        #: per attempt instead of the whole fan-out timeout
+        self.request_timeout_s = (float(request_timeout_s)
+                                  if request_timeout_s else None)
+        #: bounded retries on TRANSIENT per-host failures (routed mode; the
+        #: replicate stream is a collective and cannot re-send a seq)
+        self.retries = int(retries)
+        self.retry_backoff = Backoff(base_s=retry_backoff_s, cap_s=2.0,
+                                     jitter=0.1, seed=0)
+        self._sleep = time.sleep  # injectable: retry tests never sleep
         self.timers = timers if timers is not None else PhaseTimers()
         self.broken: str | None = None
         self._lock = threading.Lock()
@@ -357,8 +451,9 @@ class PodFanout:
             conns = self._tls.conns = {}
         c = conns.get(ep.url)
         if c is None:
-            c = http.client.HTTPConnection(ep.host, ep.port,
-                                           timeout=self.timeout_s)
+            c = http.client.HTTPConnection(
+                ep.host, ep.port,
+                timeout=self.request_timeout_s or self.timeout_s)
             conns[ep.url] = c
         return c
 
@@ -438,11 +533,16 @@ class PodFanout:
                 with self._lock:
                     ep.errors += 1
                     ep.last_error = str(e)
+                # drain-then-fail: the health state records WHICH host took
+                # the pod down, and the monitor's pod-reset path undrains
+                # it once the whole pod restarts consistently
+                ep.health.force_drain(str(e))
                 err = err or e
                 continue
             with self._lock:
                 ep.ok += 1
                 ep.latency.record(dt)
+            ep.health.note_success()
             dts.append(dt)
             out_d[rows] = dists
             out_n[rows] = nbrs
@@ -473,7 +573,10 @@ class PodFanout:
     # ------------------------------------------------------------------ admin
 
     def probe_health(self, timeout_s: float = 2.0) -> dict:
-        """GET every host's /healthz; {url: {"ok": bool, ...}}."""
+        """GET every host's /healthz; {url: {"ok": bool, ...}}. Failures
+        are no longer swallowed silently: each lands in the endpoint's
+        ``last_error`` + ``probe_errors`` counter, so the health monitor
+        and a /stats reader see the same truth."""
         out = {}
         for ep in self.endpoints:
             try:
@@ -482,8 +585,11 @@ class PodFanout:
                     out[ep.url] = {"ok": r.status == 200,
                                    **json.loads(r.read().decode())}
             except Exception as e:  # noqa: BLE001 - down IS the answer
-                out[ep.url] = {"ok": False,
-                               "error": f"{type(e).__name__}: {e}"}
+                msg = f"healthz probe failed: {type(e).__name__}: {e}"
+                with self._lock:
+                    ep.probe_errors += 1
+                    ep.last_error = msg
+                out[ep.url] = {"ok": False, "error": msg}
         return out
 
     def scrape_host_stats(self, timeout_s: float = 5.0) -> dict:
@@ -493,9 +599,33 @@ class PodFanout:
                 with urllib.request.urlopen(ep.url.rstrip("/") + "/stats",
                                             timeout=timeout_s) as r:
                     out[ep.url] = json.loads(r.read().decode())
-            except Exception as e:  # noqa: BLE001 - stats are decoration
-                out[ep.url] = {"error": f"{type(e).__name__}: {e}"}
+            except Exception as e:  # noqa: BLE001 - surfaced per host
+                msg = f"stats scrape failed: {type(e).__name__}: {e}"
+                with self._lock:
+                    ep.scrape_errors += 1
+                    ep.last_error = msg
+                out[ep.url] = {"error": msg}
         return out
+
+    def reset_stream(self, next_seq: int) -> None:
+        """Clean-restart path (replicate mode): clear the broken marker and
+        re-align the front end's sequence counter with the (restarted)
+        hosts' consistent ``next_seq`` — only the health monitor calls
+        this, after validating every host's fingerprint."""
+        with self._lock:
+            self.broken = None
+            self._seq = int(next_seq)
+
+    def drained_mask(self) -> np.ndarray:
+        """bool[H]: which endpoints are currently drained/rejoining."""
+        return np.array([ep.health.is_drained() for ep in self.endpoints],
+                        bool)
+
+    def health_snapshot(self) -> dict:
+        return {ep.url: dict(ep.health.snapshot(), retries=ep.retries,
+                             probe_errors=ep.probe_errors,
+                             scrape_errors=ep.scrape_errors)
+                for ep in self.endpoints}
 
     def close(self) -> None:
         """Stop the fan-out pool. Worker threads exit and their cached
@@ -504,6 +634,7 @@ class PodFanout:
         self._pool.shutdown(wait=False)
 
     def stats(self) -> dict:
+        health = self.health_snapshot()
         with self._lock:
             return {
                 "hosts": [ep.url for ep in self.endpoints],
@@ -513,9 +644,14 @@ class PodFanout:
                 "straggler_seconds_total": round(self.straggler_seconds, 6),
                 "per_host": {
                     ep.url: {"ok": ep.ok, "errors": ep.errors,
+                             "retries": ep.retries,
+                             "probe_errors": ep.probe_errors,
+                             "scrape_errors": ep.scrape_errors,
+                             "state": ep.health.state,
                              "last_error": ep.last_error,
                              "latency": ep.latency.report()}
                     for ep in self.endpoints},
+                "health": health,
             }
 
 
@@ -602,9 +738,15 @@ class RoutedPodFanout(PodFanout):
 
     def __init__(self, host_urls: list[str], *, k: int, max_batch: int,
                  bounds: PodBoundsTable, timeout_s: float = 120.0,
-                 timers: PhaseTimers | None = None, dim: int = 3):
+                 timers: PhaseTimers | None = None, dim: int = 3,
+                 retries: int = 2, retry_backoff_s: float = 0.05,
+                 request_timeout_s: float | None = None,
+                 health_config: dict | None = None):
         super().__init__(host_urls, k=k, max_batch=max_batch,
-                         timeout_s=timeout_s, timers=timers, dim=dim)
+                         timeout_s=timeout_s, timers=timers, dim=dim,
+                         retries=retries, retry_backoff_s=retry_backoff_s,
+                         request_timeout_s=request_timeout_s,
+                         health_config=health_config)
         if bounds.num_hosts != len(self.endpoints):
             raise ValueError(f"bounds table covers {bounds.num_hosts} "
                              f"hosts, fan-out has {len(self.endpoints)}")
@@ -614,15 +756,19 @@ class RoutedPodFanout(PodFanout):
         # routing accounting (under self._lock)
         self.escalations = 0
         self.escalation_waves = 0
+        self.degraded_rows = 0
+        self.host_loss_events = 0
         self.hosts_per_query: Counter = Counter()
         for ep in self.endpoints:
             ep.routed_rows = 0
 
     # ------------------------------------------------------------- transport
 
-    def _post_route(self, ep: _HostEndpoint, body: bytes, m: int):
-        """POST one sub-batch to one routed host; parse its candidate rows.
-        Returns (d2 f32[m,k], idx i32[m,k], seconds)."""
+    def _route_once(self, ep: _HostEndpoint, body: bytes, m: int):
+        """ONE POST attempt to one routed host; parse its candidate rows.
+        Returns (d2 f32[m,k], idx i32[m,k], seconds); raises
+        ``HostCallError`` classified transient (5xx, timeouts, connect
+        errors, torn payloads — worth a retry) or not (4xx config)."""
         k = self.k
         t0 = time.perf_counter()
         try:
@@ -633,28 +779,46 @@ class RoutedPodFanout(PodFanout):
             resp = conn.getresponse()
             payload = resp.read()
             if resp.status != 200:
-                raise PodBrokenError(
+                raise HostCallError(
                     f"host {ep.url} answered {resp.status}: "
-                    f"{payload[:300].decode(errors='replace')}")
+                    f"{payload[:300].decode(errors='replace')}",
+                    transient=resp.status >= 500)
             got = int(resp.getheader("X-Knn-Rows", "-1"))
             kk = int(resp.getheader("X-Knn-K", str(k)))
             if got != m or kk != k or len(payload) != 8 * m * k:
-                raise PodBrokenError(
+                raise HostCallError(
                     f"host {ep.url} partial malformed: rows={got} (want "
                     f"{m}) k={kk} bytes={len(payload)}")
             d2 = np.frombuffer(payload, "<f4",
                                count=m * k).reshape(m, k)
             idx = np.frombuffer(payload, "<i4", count=m * k,
                                 offset=4 * m * k).reshape(m, k)
-        except PodBrokenError:
+        except HostCallError:
             self._drop_conn(ep)
             raise
         except Exception as e:
             self._drop_conn(ep)
-            raise PodBrokenError(
+            raise HostCallError(
                 f"host {ep.url} unreachable: "
                 f"{type(e).__name__}: {e}") from e
         return d2, idx, time.perf_counter() - t0
+
+    def _post_route(self, ep: _HostEndpoint, body: bytes, m: int):
+        """`_route_once` with bounded retries + deterministic backoff on
+        TRANSIENT failures (the /route_knn contract is idempotent — a
+        routed sub-batch is a pure read, so re-sending it is always safe,
+        unlike the replicate stream's seq-consuming /shard_knn)."""
+        attempt = 0
+        while True:
+            try:
+                return self._route_once(ep, body, m)
+            except HostCallError as e:
+                if not e.transient or attempt >= self.retries:
+                    raise
+                attempt += 1
+                with self._lock:
+                    ep.retries += 1
+                self._sleep(self.retry_backoff.delay(attempt, key=ep.url))
 
     def _submit_wave(self, q: np.ndarray, rows_by_host) -> list:
         """Post per-host sub-batches concurrently; returns
@@ -673,14 +837,14 @@ class RoutedPodFanout(PodFanout):
     # ---------------------------------------------------------- query_fn API
 
     def dispatch(self, queries: np.ndarray):
-        """Wave 1: each query to its nearest-bounds host, PLUS every host
-        whose boxes contain it (non-blocking). A zero lower bound can
-        never be certified away (0 <= kth_dist2 always), so an
-        inside-the-box host would be escalated to unconditionally —
-        visiting it in wave 1 spends the same rows one round trip
-        earlier, which is most of the boundary traffic's latency."""
-        if self.broken:
-            raise PodBrokenError(self.broken)
+        """Wave 1: each query to its nearest-bounds AVAILABLE host, PLUS
+        every available host whose boxes contain it (non-blocking). A zero
+        lower bound can never be certified away (0 <= kth_dist2 always),
+        so an inside-the-box host would be escalated to unconditionally —
+        visiting it in wave 1 spends the same rows one round trip earlier,
+        which is most of the boundary traffic's latency. Drained hosts are
+        simply not routed to — whether the answers they would have touched
+        are 503d or served degraded is ``complete``'s caller's policy."""
         q = np.ascontiguousarray(np.asarray(queries, np.float32)
                                  .reshape(-1, self.dim))
         n = len(q)
@@ -688,9 +852,11 @@ class RoutedPodFanout(PodFanout):
         visited = np.zeros((n, len(self.endpoints)), bool)
         futs = []
         if n:
-            first = np.argmin(lb, axis=1)
-            reachable = np.isfinite(lb[np.arange(n), first])
-            visited |= lb <= 0.0
+            avail = ~self.drained_mask()
+            lb_route = np.where(avail[None, :], lb, np.inf)
+            first = np.argmin(lb_route, axis=1)
+            reachable = np.isfinite(lb_route[np.arange(n), first])
+            visited |= (lb <= 0.0) & avail[None, :]
             visited[np.nonzero(reachable)[0], first[reachable]] = True
             waves = [(h, np.nonzero(visited[:, h])[0])
                      for h in range(len(self.endpoints))]
@@ -699,12 +865,25 @@ class RoutedPodFanout(PodFanout):
                 "futs": futs, "t0": time.perf_counter()}
 
     def complete(self, handle):
-        """Fold wave partials; escalate uncertified (query, host) pairs."""
+        """Fold wave partials; escalate uncertified (query, host) pairs.
+
+        Returns ``(dists, idx, exact)``. A host that fails all its retries
+        feeds the health state machine (eventually draining it) and its
+        sub-batch is put back on the uncertified list: while the host
+        stays available the next wave retries it, and once it drains the
+        loop routes around it. After certification converges, any (query,
+        drained-host) pair whose bound could still improve the query marks
+        that query ``exact=False`` — the fold of the surviving hosts'
+        partials is still well-defined (commutative), just possibly
+        missing that slab's candidates. Queries whose certified routing
+        set never touched a drained slab stay bit-identical to a healthy
+        pod."""
         n, k = handle["n"], self.k
         cur_d2 = np.full((n, k), np.inf, np.float32)
         cur_idx = np.full((n, k), -1, np.int32)
         if n == 0:
-            return np.zeros(0, np.float32), cur_idx
+            return (np.zeros(0, np.float32), cur_idx,
+                    np.zeros(0, bool))
         q, visited = handle["q"], handle["visited"]
         # the dim-scaled slack makes the certification conservative
         # against the engines' f32 rounding (routing_cert_slack)
@@ -713,46 +892,64 @@ class RoutedPodFanout(PodFanout):
         futs = handle["futs"]
         dts = []
         wave = 1
+        # per-BATCH failure budget per host: wave-level retries are capped
+        # independently of the global drain threshold, so a host that
+        # keeps answering /healthz (resetting its failure streak via the
+        # monitor) while failing /route_knn can never loop this batch
+        # forever — once over budget it is treated as unavailable for THIS
+        # batch and its queries resolve per the on-host-loss policy
+        batch_failures = np.zeros(len(self.endpoints), int)
         while True:
-            err: PodBrokenError | None = None
             for h, rows, fut in futs:
                 ep = self.endpoints[h]
                 try:
                     d2, idx, dt = fut.result()
-                except PodBrokenError as e:
+                except HostCallError as e:
                     with self._lock:
                         ep.errors += 1
                         ep.last_error = str(e)
-                    err = err or e
+                    ep.health.note_failure(str(e))
+                    batch_failures[h] += 1
+                    # un-visit the lost sub-batch: if the host is still
+                    # available the certification loop re-dispatches it
+                    # (wave-level retry); once drained or over its batch
+                    # budget, these pairs surface as uncertified ->
+                    # degraded/failed per policy
+                    visited[rows, h] = False
                     continue
                 with self._lock:
                     ep.ok += 1
                     ep.latency.record(dt)
                     ep.routed_rows += len(rows)
+                ep.health.note_success()
                 dts.append(dt)
                 _fold_candidates(cur_d2, cur_idx, rows, d2, idx, k)
-            if err is not None:
-                # certification needs every routed host's answer: a lost
-                # partial is not degradable (same fail-stop contract as
-                # the replicate-everything pod)
-                with self._lock:
-                    self.broken = self.broken or str(err)
-                raise err
             r2 = cur_d2[:, k - 1].astype(np.float64)
             need = (~visited) & reachable & (lb_safe <= r2[:, None])
-            if not need.any():
+            avail = (~self.drained_mask()
+                     & (batch_failures <= self.retries))
+            dispatchable = need & avail[None, :]
+            if not dispatchable.any():
                 break
             with self._lock:
                 if wave == 1:
-                    self.escalations += int(need.any(axis=1).sum())
+                    self.escalations += int(
+                        dispatchable.any(axis=1).sum())
                 self.escalation_waves += 1
             wave += 1
-            waves = [(h, np.nonzero(need[:, h])[0])
+            waves = [(h, np.nonzero(dispatchable[:, h])[0])
                      for h in range(len(self.endpoints))]
-            visited |= need
+            visited |= dispatchable
             futs = self._submit_wave(q, waves)
+        # certification closed over the AVAILABLE hosts; whatever remains
+        # uncertified points at drained slabs — those queries are inexact
+        uncertified = (~visited) & reachable & (lb_safe <= r2[:, None])
+        exact = ~uncertified.any(axis=1)
         with self._lock:
             self.batches += 1
+            if not exact.all():
+                self.degraded_rows += int((~exact).sum())
+                self.host_loss_events += 1
             self.hosts_per_query.update(
                 visited.sum(axis=1).astype(int).tolist())
             if len(dts) > 1:
@@ -761,7 +958,7 @@ class RoutedPodFanout(PodFanout):
                 self.timers.hist("fanout_straggler_seconds").record(spread)
         self.timers.hist("fanout_batch_seconds").record(
             time.perf_counter() - handle["t0"])
-        return np.sqrt(cur_d2[:, k - 1]), cur_idx
+        return np.sqrt(cur_d2[:, k - 1]), cur_idx, exact
 
     # ------------------------------------------------------------------ admin
 
@@ -774,6 +971,8 @@ class RoutedPodFanout(PodFanout):
                 "mode": "bounds",
                 "escalations": self.escalations,
                 "escalation_waves": self.escalation_waves,
+                "degraded_rows": self.degraded_rows,
+                "host_loss_events": self.host_loss_events,
                 "routed_rows": {ep.url: ep.routed_rows
                                 for ep in self.endpoints},
                 "hosts_per_query": {str(c): int(v) for c, v in
@@ -810,8 +1009,19 @@ class FrontendServer(ThreadingHTTPServer):
 
     def __init__(self, addr, fanout: PodFanout, *, max_delay_s=0.002,
                  max_queue_rows=4096, default_timeout_s=5.0,
-                 pipeline_depth=2, min_batch=8, verbose=False):
+                 pipeline_depth=2, min_batch=8, on_host_loss="fail",
+                 verbose=False):
+        if on_host_loss not in ("fail", "degrade"):
+            raise ValueError(f"on_host_loss must be 'fail' or 'degrade', "
+                             f"got {on_host_loss!r}")
         self.fanout = fanout
+        #: what happens to queries whose certified routing set touches a
+        #: drained slab: "fail" 503s them (exactness preserved), "degrade"
+        #: serves the surviving hosts' fold flagged ``exact: false``
+        self.on_host_loss = on_host_loss
+        #: background drain/rejoin supervisor (serve/health.py); attached
+        #: by build_frontend, stopped by close()
+        self.monitor: HealthMonitor | None = None
         self.admission = AdmissionController(
             max_queue_rows=max_queue_rows,
             default_timeout_s=default_timeout_s)
@@ -822,6 +1032,10 @@ class FrontendServer(ThreadingHTTPServer):
                                       min_batch=min_batch)
         self.admission.pipeline_rows_fn = self.batcher.inflight_rows
         self.metrics = ServingMetrics()
+        # pre-seed the failure-path counters so dashboards see zeros, not
+        # missing series, before the first incident
+        for name in ("knn_degraded_responses_total", "knn_unavailable_total"):
+            self.metrics.counters.setdefault(name, 0)
         self.ready = False
         self.verbose = verbose
         self._loop_entered = False
@@ -832,6 +1046,8 @@ class FrontendServer(ThreadingHTTPServer):
         super().serve_forever(poll_interval)
 
     def close(self):
+        if self.monitor is not None:
+            self.monitor.stop()
         self.batcher.shutdown()
         self.fanout.close()
         if self._loop_entered:
@@ -847,17 +1063,49 @@ class _FrontendHandler(JsonHttpHandler):
         srv: FrontendServer = self.server
         path = urlparse(self.path).path
         if path == "/healthz":
-            hosts = srv.fanout.probe_health()
-            ok = (srv.ready and srv.fanout.broken is None
-                  and all(h.get("ok") for h in hosts.values()))
-            self._send_json(200 if ok else 503, {
-                "status": "ok" if ok else "degraded",
+            # with a running monitor, answer from its supervised state (no
+            # inline probe storm per scrape); otherwise probe live
+            if srv.monitor is not None and srv.monitor.running:
+                # suspect still counts as up: it is serving every request
+                # (one blip of fail_threshold); only drained/rejoining
+                # hosts are genuinely out of rotation
+                hosts = {url: {"ok": h["state"] in ("healthy", "suspect"),
+                               **h}
+                         for url, h in srv.fanout.health_snapshot().items()}
+            else:
+                hosts = srv.fanout.probe_health()
+            n_ok = sum(1 for h in hosts.values() if h.get("ok"))
+            routed = getattr(srv.fanout, "routing_mode", "off") == "bounds"
+            broken = srv.fanout.broken
+            if broken or n_ok == 0 or not srv.ready:
+                status, code = ("broken" if broken else "degraded"), 503
+            elif n_ok == len(hosts):
+                status, code = "ok", 200
+            elif routed:
+                # partial capacity: a routed pod keeps serving around the
+                # drained slab (degraded or selectively 503d per policy)
+                status, code = "degraded", 200
+            else:
+                status, code = "degraded", 503
+            self._send_json(code, {
+                "status": status,
                 "role": "pod-frontend",
-                "broken": srv.fanout.broken,
+                "broken": broken,
+                "on_host_loss": srv.on_host_loss,
                 "hosts": hosts})
         elif path == "/stats":
+            fan_stats = srv.fanout.stats()
             self._send_json(200, {
-                "fanout": srv.fanout.stats(),
+                "fanout": fan_stats,
+                "pod": {
+                    "on_host_loss": srv.on_host_loss,
+                    "broken": srv.fanout.broken,
+                    # same snapshot the fanout block embeds — taken once,
+                    # so the two read paths can never diverge
+                    "health": fan_stats["health"],
+                    "monitor": (srv.monitor.stats()
+                                if srv.monitor is not None else None),
+                },
                 "batcher": srv.batcher.stats(),
                 "admission": srv.admission.stats(),
                 "server": dict(srv.metrics.counters,
@@ -909,6 +1157,23 @@ class _FrontendHandler(JsonHttpHandler):
                       f'knn_host_errors_total{{host="{url}"}} {h["errors"]}']
             if p99 is not None:
                 lines += [f'knn_host_p99_seconds{{host="{url}"}} {p99}']
+        # supervised lifecycle surface: state enum (0 healthy / 1 suspect /
+        # 2 drained / 3 rejoining), dispatch retries, cumulative drained
+        # seconds — the drain/rejoin story as numbers
+        lines += ["# TYPE knn_host_state gauge"] + [
+            f'knn_host_state{{host="{url}"}} {h["state_code"]}'
+            for url, h in f["health"].items()]
+        lines += ["# TYPE knn_dispatch_retries_total counter"] + [
+            f'knn_dispatch_retries_total{{host="{url}"}} {h["retries"]}'
+            for url, h in f["health"].items()]
+        lines += ["# TYPE knn_host_drained_seconds_total counter"] + [
+            f'knn_host_drained_seconds_total{{host="{url}"}} '
+            f'{h["drained_seconds_total"]}'
+            for url, h in f["health"].items()]
+        lines += ["# TYPE knn_host_probe_errors_total counter"] + [
+            f'knn_host_probe_errors_total{{host="{url}"}} '
+            f'{h["probe_errors"]}'
+            for url, h in f["health"].items()]
         # shard-local routing observability: escalation + per-host routed
         # rows + the hosts-visited-per-query histogram (the routing win as
         # a number: mean ~1 = clustered traffic certifying after one host,
@@ -920,7 +1185,10 @@ class _FrontendHandler(JsonHttpHandler):
                       f"{routing['escalations']}",
                       "# TYPE knn_routing_escalation_waves_total counter",
                       f"knn_routing_escalation_waves_total "
-                      f"{routing['escalation_waves']}"]
+                      f"{routing['escalation_waves']}",
+                      "# TYPE knn_degraded_rows_total counter",
+                      f"knn_degraded_rows_total "
+                      f"{routing['degraded_rows']}"]
             lines += ["# TYPE knn_routed_rows_total counter"] + [
                 f'knn_routed_rows_total{{host="{u}"}} {v}'
                 for u, v in routing["routed_rows"].items()]
@@ -978,7 +1246,7 @@ class _FrontendHandler(JsonHttpHandler):
             return
         try:
             with srv.admission.admitted_rows(n):
-                dists, nbrs = srv.batcher.submit(q, timeout_s=timeout_s)
+                res = srv.batcher.submit(q, timeout_s=timeout_s)
         except OverloadError as e:
             srv.metrics.inc("knn_overload_total")
             self._send_json(429, {"error": str(e)},
@@ -988,19 +1256,52 @@ class _FrontendHandler(JsonHttpHandler):
             srv.metrics.inc("knn_deadline_total")
             self._send_json(504, {"error": str(e)})
             return
+        except PodBrokenError as e:
+            # drain-then-fail: the pod stream is down until the hosts
+            # restart together (the monitor's reset path) — an operational
+            # state, not a server bug, so 503 + Retry-After, never 500
+            srv.metrics.inc("knn_unavailable_total")
+            self._send_json(503, {"error": str(e)},
+                            extra=[("Retry-After", "1")])
+            return
         except Exception as e:  # noqa: BLE001 - the service must not die
             srv.metrics.inc("knn_error_total")
             self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
             return
+        # routed fan-outs return (dists, nbrs, exact); the replicate pod's
+        # stream is all-or-nothing, so its results are always exact
+        dists, nbrs = res[0], res[1]
+        exact = res[2] if len(res) > 2 else None
+        all_exact = bool(exact.all()) if exact is not None else True
+        if not all_exact and srv.on_host_loss == "fail":
+            # only the queries whose certified routing set touches the
+            # drained slab are refused; a request is the granularity the
+            # client can retry, so any inexact row 503s the request
+            srv.metrics.inc("knn_unavailable_total")
+            self._send_json(503, {
+                "error": f"{int((~exact).sum())} of {n} queries touch a "
+                         "drained host slab (on-host-loss=fail); retry "
+                         "after the host rejoins",
+                "exact": False},
+                extra=[("Retry-After", "1")])
+            return
+        if not all_exact:
+            srv.metrics.inc("knn_degraded_responses_total")
         srv.metrics.inc("knn_rows_total", n)
         srv.metrics.latency.record(time.perf_counter() - t0)
         if binary:
             self._send(200, np.asarray(dists, "<f4").tobytes(),
-                       "application/octet-stream")
+                       "application/octet-stream",
+                       extra=([] if exact is None else
+                              [("X-Knn-Exact", "1" if all_exact else "0")]))
         else:
             out = {"dists": np.asarray(dists, np.float64).tolist()}
             if want_nbrs:
                 out["neighbors"] = np.asarray(nbrs).tolist()
+            if exact is not None:
+                out["exact"] = all_exact
+                if not all_exact:
+                    out["exact_per_query"] = [bool(x) for x in exact]
             self._send_json(200, out)
 
 
@@ -1058,6 +1359,11 @@ def pod_config_from_hosts(host_urls: list[str],
         raise ValueError(f"front end asked for routing='{routing}' but the "
                          f"hosts serve routing='{mode}'")
     stats = [s["engine"] for s in raw]
+    # per-host config/bounds fingerprints, captured at validation time: the
+    # health monitor's rejoin gate compares a RETURNING host against these
+    # (serve/health.py host_fingerprint) before undraining it
+    fingerprints = {url: host_fingerprint(e, mode)
+                    for url, e in zip(host_urls, stats)}
     if mode == "bounds":
         ref = stats[0]
         for url, e in zip(host_urls, stats):
@@ -1102,6 +1408,7 @@ def pod_config_from_hosts(host_urls: list[str],
             offset += e["n_points"]
         return {"routing": "bounds",
                 "host_urls": [host_urls[i] for i in order],
+                "fingerprints": fingerprints,
                 "k": ref["k"], "dim": ref.get("dim", 3),
                 "max_batch": min(e["max_batch"] for e in stats),
                 # routed sub-batches start the moment a host is idle (no
@@ -1138,6 +1445,7 @@ def pod_config_from_hosts(host_urls: list[str],
             f"{ref['num_shards']} — slices would be missing rows")
     return {"routing": "off",
             "host_urls": list(host_urls),
+            "fingerprints": fingerprints,
             "k": ref["k"], "max_batch": ref["max_batch"],
             "min_batch": ref["shape_buckets"][0],
             "num_shards": ref["num_shards"], "n_points": ref["n_points"],
@@ -1148,27 +1456,51 @@ def build_frontend(host_urls: list[str], *, host: str = "127.0.0.1",
                    port: int = 8080, max_delay_s: float = 0.002,
                    pipeline_depth: int = 2, max_queue_rows: int = 4096,
                    default_timeout_s: float = 5.0, timeout_s: float = 120.0,
-                   routing: str = "auto",
+                   routing: str = "auto", on_host_loss: str = "fail",
+                   retries: int = 2, retry_backoff_s: float = 0.05,
+                   request_timeout_s: float | None = None,
+                   probe_interval_s: float = 5.0, fail_threshold: int = 3,
+                   health_config: dict | None = None,
+                   start_monitor: bool = True,
                    verbose: bool = False) -> FrontendServer:
     """Validate the pod and construct (but do not start) a FrontendServer;
     ``port=0`` picks a free port (``server.server_address[1]``).
     ``routing`` selects the fan-out: "off" = replicate-everything pod,
-    "bounds" = shard-local routing, "auto" = whatever the hosts serve."""
+    "bounds" = shard-local routing, "auto" = whatever the hosts serve.
+    ``on_host_loss`` picks the drained-slab policy (fail = 503 affected
+    queries, degrade = serve them flagged ``exact: false``); the health
+    monitor starts supervising immediately unless ``start_monitor=False``
+    (tests drive ``server.monitor.check_once()`` by hand instead)."""
     cfg = pod_config_from_hosts(host_urls, routing=routing)
+    hc = dict(fail_threshold=fail_threshold,
+              probe_interval_s=probe_interval_s)
+    hc.update(health_config or {})
     if cfg["routing"] == "bounds":
         table = PodBoundsTable(cfg["bounds_hosts"], cfg["dim"])
         fanout: PodFanout = RoutedPodFanout(
             cfg["host_urls"], k=cfg["k"], max_batch=cfg["max_batch"],
-            bounds=table, timeout_s=timeout_s, dim=cfg["dim"])
+            bounds=table, timeout_s=timeout_s, dim=cfg["dim"],
+            retries=retries, retry_backoff_s=retry_backoff_s,
+            request_timeout_s=request_timeout_s, health_config=hc)
     else:
         fanout = PodFanout(cfg["host_urls"], k=cfg["k"],
                            max_batch=cfg["max_batch"],
-                           timeout_s=timeout_s, dim=cfg["dim"])
-    return FrontendServer((host, port), fanout, max_delay_s=max_delay_s,
-                          pipeline_depth=pipeline_depth,
-                          max_queue_rows=max_queue_rows,
-                          default_timeout_s=default_timeout_s,
-                          min_batch=cfg["min_batch"], verbose=verbose)
+                           timeout_s=timeout_s, dim=cfg["dim"],
+                           retries=retries, retry_backoff_s=retry_backoff_s,
+                           request_timeout_s=request_timeout_s,
+                           health_config=hc)
+    server = FrontendServer((host, port), fanout, max_delay_s=max_delay_s,
+                            pipeline_depth=pipeline_depth,
+                            max_queue_rows=max_queue_rows,
+                            default_timeout_s=default_timeout_s,
+                            min_batch=cfg["min_batch"],
+                            on_host_loss=on_host_loss, verbose=verbose)
+    server.monitor = HealthMonitor(fanout,
+                                   fingerprints=cfg["fingerprints"],
+                                   mode=cfg["routing"])
+    if start_monitor:
+        server.monitor.start()
+    return server
 
 
 FRONTEND_FLAGS = """
@@ -1187,6 +1519,23 @@ FRONTEND_FLAGS = """
                     routes each query only to hosts whose shard AABBs can
                     beat its current k-th distance, with certified
                     escalation (docs/SERVING.md "Shard-local routing")
+  --on-host-loss P  fail | degrade (default fail): what happens to queries
+                    whose certified routing set touches a DRAINED host —
+                    fail answers them 503 + Retry-After (exactness
+                    preserved), degrade serves the surviving hosts' fold
+                    flagged exact:false (docs/SERVING.md "Failure
+                    handling & degraded mode")
+  --retries N       bounded retries per routed sub-batch on transient
+                    failures: connect errors, timeouts, 5xx (default 2)
+  --retry-backoff-ms F  base of the capped-exponential retry backoff
+                    (default 50; deterministic jitter rides on top)
+  --request-timeout-ms F  per-TRY budget for routed host posts (default:
+                    the pod-wide --fanout-timeout); one slow host burns at
+                    most this per attempt instead of poisoning the batch
+  --probe-interval-s F  health monitor probe cadence for healthy hosts
+                    (default 5; drained hosts re-probe on capped
+                    exponential backoff + jitter)
+  --fail-threshold N  consecutive failures that drain a host (default 3)
   --verbose         log each HTTP request to stderr
 """
 
@@ -1198,7 +1547,11 @@ def main(argv: list[str] | None = None) -> int:
     opt = {"hosts": "", "port": 8080, "host": "127.0.0.1",
            "max_delay_ms": 2.0, "pipeline_depth": 2,
            "max_queue_rows": 4096, "timeout_ms": 5000.0,
-           "wait_ready_s": 600.0, "routing": "auto", "verbose": False}
+           "wait_ready_s": 600.0, "routing": "auto",
+           "on_host_loss": "fail", "retries": 2,
+           "retry_backoff_ms": 50.0, "request_timeout_ms": 0.0,
+           "probe_interval_s": 5.0, "fail_threshold": 3,
+           "verbose": False}
     i = 0
     try:
         while i < len(args):
@@ -1221,6 +1574,18 @@ def main(argv: list[str] | None = None) -> int:
                 i += 1; opt["wait_ready_s"] = float(args[i])
             elif a == "--routing":
                 i += 1; opt["routing"] = args[i]
+            elif a == "--on-host-loss":
+                i += 1; opt["on_host_loss"] = args[i]
+            elif a == "--retries":
+                i += 1; opt["retries"] = int(args[i])
+            elif a == "--retry-backoff-ms":
+                i += 1; opt["retry_backoff_ms"] = float(args[i])
+            elif a == "--request-timeout-ms":
+                i += 1; opt["request_timeout_ms"] = float(args[i])
+            elif a == "--probe-interval-s":
+                i += 1; opt["probe_interval_s"] = float(args[i])
+            elif a == "--fail-threshold":
+                i += 1; opt["fail_threshold"] = int(args[i])
             elif a == "--verbose":
                 opt["verbose"] = True
             else:
@@ -1242,12 +1607,18 @@ def main(argv: list[str] | None = None) -> int:
         pipeline_depth=opt["pipeline_depth"],
         max_queue_rows=opt["max_queue_rows"],
         default_timeout_s=opt["timeout_ms"] / 1e3,
-        routing=opt["routing"], verbose=opt["verbose"])
+        routing=opt["routing"], on_host_loss=opt["on_host_loss"],
+        retries=opt["retries"],
+        retry_backoff_s=opt["retry_backoff_ms"] / 1e3,
+        request_timeout_s=(opt["request_timeout_ms"] / 1e3
+                           if opt["request_timeout_ms"] > 0 else None),
+        probe_interval_s=opt["probe_interval_s"],
+        fail_threshold=opt["fail_threshold"], verbose=opt["verbose"])
     server.ready = True
     h, p = server.server_address[:2]
     mode = getattr(server.fanout, "routing_mode", "off")
     print(f"pod front end on http://{h}:{p} fanning to {len(hosts)} host(s) "
-          f"(routing={mode})")
+          f"(routing={mode}, on-host-loss={opt['on_host_loss']})")
     try:
         server.serve_forever()
     except KeyboardInterrupt:

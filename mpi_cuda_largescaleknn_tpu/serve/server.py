@@ -38,6 +38,10 @@ from mpi_cuda_largescaleknn_tpu.serve.admission import (
 )
 from mpi_cuda_largescaleknn_tpu.serve.batcher import DynamicBatcher
 from mpi_cuda_largescaleknn_tpu.serve.engine import UnservableShapeError
+from mpi_cuda_largescaleknn_tpu.serve.faults import (
+    FaultInjector,
+    apply_http_fault,
+)
 
 
 def parse_knn_body(path: str, headers, rfile, dim: int = 3):
@@ -90,8 +94,12 @@ class KnnServer(ThreadingHTTPServer):
 
     def __init__(self, addr, engine, *, max_delay_s=0.002,
                  max_queue_rows=4096, default_timeout_s=5.0, query_fn=None,
-                 verbose=False, pipeline_depth=2):
+                 verbose=False, pipeline_depth=2, faults=None):
         self.engine = engine
+        #: deterministic fault injection (serve/faults.py; KNN_FAULTS env)
+        #: — the single-host twin of the pod hosts' injector, so failure
+        #: drills run against any serving tier
+        self.faults = faults if faults is not None else FaultInjector.from_env()
         self.admission = AdmissionController(
             max_queue_rows=max_queue_rows,
             default_timeout_s=default_timeout_s)
@@ -157,6 +165,14 @@ class JsonHttpHandler(BaseHTTPRequestHandler):
 
     def _send_json(self, code: int, obj, extra=()):
         self._send(code, json.dumps(obj).encode(), "application/json", extra)
+
+    def _apply_fault(self, path: str) -> bool:
+        """Consult the server's FaultInjector (if any) for this request;
+        True when an injected fault consumed it (serve/faults.py)."""
+        inj = getattr(self.server, "faults", None)
+        if inj is None or not inj.active():
+            return False
+        return apply_http_fault(self, inj.decide(path))
 
 
 class _Handler(JsonHttpHandler):
@@ -271,6 +287,8 @@ class _Handler(JsonHttpHandler):
         srv: KnnServer = self.server
         if urlparse(self.path).path != "/knn":
             self._send_json(404, {"error": "POST /knn only"})
+            return
+        if self._apply_fault("/knn"):
             return
         srv.metrics.inc("knn_requests_total")
         t0 = time.perf_counter()

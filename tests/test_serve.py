@@ -914,7 +914,7 @@ class TestLoadgen:
         try:
             for seed in (21, 22):
                 q = random_points(6, seed=seed)
-                status, payload = client._request(
+                status, payload, _headers = client._request(
                     "/knn", np.ascontiguousarray(q, np.float32).tobytes(),
                     "application/octet-stream")
                 assert status == 200

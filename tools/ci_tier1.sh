@@ -30,8 +30,13 @@ if [ "${SERVE_BENCH:-1}" != "0" ] && [ "$rc" -ne 124 ]; then
   # being bitwise identical between the two (tie ids included) and
   # oracle-exact; multihost_compare additionally gates on its
   # qps_ratio_pod_vs_single regression floor
+  # --chaos-bench adds the fault-tolerance section (chaos_compare): one
+  # routed host killed mid-load via a deterministic fault-injected
+  # outage — gated on availability under single-host loss (degrade mode
+  # keeps answering, flagged exact:false) AND post-rejoin bitwise parity
   timeout -k 10 2400 python tools/serve_smoke.py --duration 2 --trials 3 \
       --locality-bench --multihost-bench --kernel-bench --routing-bench \
+      --chaos-bench \
       --out BENCH_serve.json >/dev/null || { brc=$?; [ "$rc" -eq 0 ] && rc=$brc; }
 fi
 exit $rc

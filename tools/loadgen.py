@@ -74,7 +74,7 @@ class _Client:
                                headers={"Content-Type": ctype})
             resp = self._conn.getresponse()
             payload = resp.read()  # must drain to reuse the socket
-            return resp.status, payload
+            return resp.status, payload, resp.headers
         except Exception:
             self.close()  # poisoned socket: reconnect on the next request
             raise
@@ -87,25 +87,39 @@ class _Client:
                 self._conn = None
 
     def post_batch(self, queries: np.ndarray, neighbors: bool,
-                   binary: bool) -> int:
+                   binary: bool):
+        """-> (status, degraded, retry_after_s|None). ``degraded`` is the
+        server's exactness flag for a 200 (the pod front end's degraded
+        partial answers under --on-host-loss degrade: ``"exact": false``
+        in JSON, ``X-Knn-Exact: 0`` in binary); ``retry_after_s`` echoes a
+        Retry-After header so the load loop can honor 503/429
+        backpressure instead of hammering a draining pod."""
         if binary:
             # raw f32 xyz triples in, raw f32 distances out — the server's
             # octet-stream format. Skips both sides' JSON encode/decode, so
             # the client measures the engine, not the text codec (neighbors
             # ride the query string; only the JSON response carries them)
-            status, payload = self._request(
+            status, payload, headers = self._request(
                 "/knn" + ("?neighbors=1" if neighbors else ""),
                 np.ascontiguousarray(queries, np.float32).tobytes(),
                 "application/octet-stream")
+            degraded = False
             if status == 200:
                 np.frombuffer(payload, np.float32)
-            return status
-        status, payload = self._request(
-            "/knn", json.dumps({"queries": queries.tolist(),
-                                "neighbors": neighbors}).encode(),
-            "application/json")
-        json.loads(payload.decode())
-        return status
+                degraded = headers.get("X-Knn-Exact") == "0"
+        else:
+            status, payload, headers = self._request(
+                "/knn", json.dumps({"queries": queries.tolist(),
+                                    "neighbors": neighbors}).encode(),
+                "application/json")
+            obj = json.loads(payload.decode())
+            degraded = status == 200 and obj.get("exact") is False
+        ra = headers.get("Retry-After")
+        try:
+            retry_after_s = float(ra) if ra is not None else None
+        except ValueError:
+            retry_after_s = None
+        return status, degraded, retry_after_s
 
 
 def _server_pipeline_stats(url: str, timeout_s: float) -> dict | None:
@@ -213,29 +227,39 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
     hist = LatencyHistogram()
     ep_hists = {u: LatencyHistogram() for u in endpoints}
     lock = threading.Lock()
-    counts = {"ok": 0, "overload": 0, "deadline": 0, "http_error": 0,
+    counts = {"ok": 0, "degraded": 0, "overload": 0, "deadline": 0,
+              "unavailable": 0, "http_error": 0,
               "net_error": 0, "rows_ok": 0, "sched_skipped": 0}
+    status_counts: dict[str, int] = {}
     ep_counts = {u: {"requests": 0, "ok": 0, "errors": 0}
                  for u in endpoints}
     stop_at = time.monotonic() + duration_s
 
-    def account(endpoint: str, status: int, dt: float, rows: int):
+    def account(endpoint: str, status: int, dt: float, rows: int,
+                degraded: bool = False):
         hist.record(dt)
         ep_hists[endpoint].record(dt)
         with lock:
             ep_counts[endpoint]["requests"] += 1
+            status_counts[str(status)] = status_counts.get(str(status), 0) + 1
             if status == 200:
                 counts["ok"] += 1
                 counts["rows_ok"] += rows
                 ep_counts[endpoint]["ok"] += 1
+                if degraded:
+                    counts["degraded"] += 1
             elif status == 429:
                 counts["overload"] += 1
+            elif status == 503:
+                counts["unavailable"] += 1
             elif status == 504:
                 counts["deadline"] += 1
             else:
                 counts["http_error"] += 1
 
     def one_request(pick_client, rng: np.random.Generator):
+        """Fire one request; returns a Retry-After backoff (seconds) the
+        caller should honor, or None."""
         if workload == "clustered":
             c = centers[rng.integers(len(centers))]
             q = np.clip(c + rng.normal(0.0, blob_sigma * scale, (batch, 3)),
@@ -245,14 +269,20 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
         endpoint, client = pick_client()
         t0 = time.perf_counter()
         try:
-            status = client.post_batch(q, neighbors, binary)
+            status, degraded, retry_after = client.post_batch(
+                q, neighbors, binary)
             account(endpoint, status, time.perf_counter() - t0,
-                    batch if status == 200 else 0)
+                    batch if status == 200 else 0, degraded)
+            if status in (429, 503) and retry_after:
+                # honor the server's backpressure (cap it: a chaos-bench
+                # outage must not park workers past the measurement)
+                return min(retry_after, 1.0)
         except Exception:  # noqa: BLE001 - connection refused/reset, timeout
             with lock:
                 counts["net_error"] += 1
                 ep_counts[endpoint]["requests"] += 1
                 ep_counts[endpoint]["errors"] += 1
+        return None
 
     def make_picker(wid: int):
         """One persistent connection per endpoint per worker; round-robin
@@ -276,7 +306,10 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
         wrng = np.random.default_rng((seed, wid))
         try:
             while time.monotonic() < stop_at:
-                one_request(pick, wrng)
+                backoff = one_request(pick, wrng)
+                if backoff:
+                    time.sleep(min(backoff, max(0.0,
+                                                stop_at - time.monotonic())))
         finally:
             close_all()
 
@@ -316,7 +349,8 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
     elapsed = time.monotonic() - t_start
 
     total = sum(counts[c] for c in
-                ("ok", "overload", "deadline", "http_error"))
+                ("ok", "overload", "deadline", "unavailable", "http_error"))
+    attempted = total + counts["net_error"]
     lat = hist.report()
 
     def _pct_ms(rep, p):
@@ -351,6 +385,17 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
         "requests": total, "qps": round(total / elapsed, 2),
         "rows_per_s": round(counts["rows_ok"] / elapsed, 2),
         **counts,
+        # availability surface (the chaos bench's primary read): fraction
+        # of ATTEMPTED requests answered 200 (degraded 200s included —
+        # they are answers, flagged), the status-code breakdown, and the
+        # degraded share of the 200s
+        "status_counts": dict(sorted(status_counts.items())),
+        "availability": (round(counts["ok"] / attempted, 4)
+                         if attempted else None),
+        "error_rate": (round((attempted - counts["ok"]) / attempted, 4)
+                       if attempted else None),
+        "degraded_rate": (round(counts["degraded"] / counts["ok"], 4)
+                          if counts["ok"] else None),
         "latency_seconds": lat,
         # None (JSON null) when nothing was measured — e.g. server down,
         # every request a net_error — keeping the report strict JSON

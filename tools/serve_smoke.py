@@ -759,6 +759,165 @@ def run_routing_bench(*, n_points=32768, k=64, hosts=2, duration_s=2.0,
     return out
 
 
+def run_chaos_bench(*, n_points=8192, k=16, hosts=2, duration_s=2.0,
+                    concurrency=8, batch=8, max_batch=128,
+                    max_delay_s=0.008, seed=0) -> dict:
+    """Chaos bench: kill one routed host mid-load (a deterministic
+    serve/faults.py ``drop`` outage injected through POST /faults — the
+    process-kill stand-in the fault layer exists for), measure
+    availability + degraded-rate under the loss, then lift the outage and
+    measure recovery time and post-rejoin BITWISE parity with the
+    pre-outage answers.
+
+    Topology: 2 in-process routed slab hosts + the real front end at
+    ``--on-host-loss degrade`` with a fast health monitor; load rides
+    tools/loadgen.py in a subprocess (its availability/status-code/
+    degraded accounting is the measurement). Each slab engine runs a
+    1-device mesh: with NO in-program collectives, two engines' programs
+    can overlap freely on the shared CPU backend (two concurrent
+    all_to_all programs would starve each other's XLA device threads and
+    rendezvous-deadlock — routed hosts in production are separate
+    processes, so only this co-located fixture cares).
+    Three phases land in the report: ``healthy`` (baseline), ``outage``
+    (one host dropping every request), ``recovered`` (after the monitor
+    rejoined the host). Gates: outage-phase availability >=
+    ``availability_floor`` (degrade mode keeps answering — flagged, not
+    refused) and ``bitwise_parity_after_rejoin`` (the fixed probe batch's
+    dists AND neighbor ids byte-equal before vs after the incident).
+    """
+    _setup_cpu_fixture(1)
+    from mpi_cuda_largescaleknn_tpu.models.sharding import slab_bounds
+    from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+    from mpi_cuda_largescaleknn_tpu.serve.engine import ResidentKnnEngine
+    from mpi_cuda_largescaleknn_tpu.serve.frontend import (
+        HostSliceServer,
+        build_frontend,
+    )
+    from mpi_cuda_largescaleknn_tpu.utils.math import morton_argsort
+
+    rng = np.random.default_rng(seed)
+    points = rng.random((n_points, 3)).astype(np.float32)
+    points = points[morton_argsort(points, points.min(0), points.max(0))]
+
+    servers = []
+    for b, e in slab_bounds(len(points), hosts):
+        eng = ResidentKnnEngine(points[b:e], k, mesh=get_mesh(1),
+                                engine="tiled", bucket_size=64,
+                                max_batch=max_batch, min_batch=16,
+                                id_offset=b, emit="candidates")
+        eng.warmup()
+        srv = HostSliceServer(("127.0.0.1", 0), eng, routing="bounds")
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        srv.ready = True
+        servers.append(srv)
+    urls = [f"http://127.0.0.1:{s.server_address[1]}" for s in servers]
+    victim = urls[-1]
+
+    fe = build_frontend(
+        urls, port=0, max_delay_s=max_delay_s, pipeline_depth=2,
+        on_host_loss="degrade", retries=2, retry_backoff_s=0.01,
+        request_timeout_s=30.0,
+        health_config=dict(fail_threshold=2, probe_interval_s=0.1,
+                           backoff_base_s=0.05, backoff_cap_s=0.5))
+    fe.ready = True
+    threading.Thread(target=fe.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{fe.server_address[1]}"
+
+    prng = np.random.default_rng(seed + 1)
+    q_probe = prng.random((64, 3)).astype(np.float32)
+
+    def probe():
+        body = json.dumps({"queries": q_probe.tolist(),
+                           "neighbors": True}).encode()
+        req = urllib.request.Request(
+            base + "/knn", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            obj = json.loads(resp.read())
+        return (np.asarray(obj["dists"], np.float32),
+                np.asarray(obj["neighbors"], np.int32),
+                bool(obj.get("exact", True)))
+
+    def set_faults(spec):
+        req = urllib.request.Request(
+            victim + "/faults", data=json.dumps({"spec": spec}).encode(),
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=30).read()
+
+    def victim_state():
+        with urllib.request.urlopen(base + "/stats", timeout=30) as r:
+            return json.loads(r.read())["pod"]["health"][victim]["state"]
+
+    def phase(trial):
+        rep = _run_loadgen(base, duration_s=duration_s,
+                           concurrency=concurrency, batch=batch,
+                           seed=seed + trial)
+        return {"qps": rep["qps"], "availability": rep["availability"],
+                "error_rate": rep["error_rate"],
+                "degraded_rate": rep["degraded_rate"],
+                "degraded": rep["degraded"], "net_error": rep["net_error"],
+                "status_counts": rep["status_counts"],
+                "p99_ms": rep["p99_ms"]}
+
+    out = {
+        "kind": "serve_chaos_bench", "hosts": hosts, "n_points": n_points,
+        "k": k, "duration_s": duration_s, "concurrency": concurrency,
+        "batch": batch, "on_host_loss": "degrade",
+        "availability_floor": 0.9,
+    }
+    try:
+        pre_d, pre_n, pre_exact = probe()
+        out["pre_probe_exact"] = pre_exact
+        out["healthy"] = phase(0)
+
+        # the incident: the victim host drops every request (route, probe,
+        # stats) — indistinguishable from a dead process to the front end
+        set_faults("drop:")
+        t_kill = time.monotonic()
+        out["outage"] = phase(1)
+        dur_d, dur_n, dur_exact = probe()
+        out["outage_probe_exact"] = dur_exact  # False: loss is FLAGGED
+        out["victim_state_during_outage"] = victim_state()
+
+        # recovery: lift the outage, let the monitor re-probe + rejoin
+        set_faults("")
+        t_clear = time.monotonic()
+        deadline = t_clear + 60.0
+        state = victim_state()
+        while state != "healthy" and time.monotonic() < deadline:
+            time.sleep(0.05)
+            state = victim_state()
+        out["victim_state_after_clear"] = state
+        out["recovery_s"] = round(time.monotonic() - t_clear, 3)
+        out["outage_total_s"] = round(time.monotonic() - t_kill, 3)
+        out["recovered"] = phase(2)
+
+        post_d, post_n, post_exact = probe()
+        out["post_probe_exact"] = post_exact
+        out["bitwise_parity_after_rejoin"] = bool(
+            post_exact and np.array_equal(pre_d, post_d)
+            and np.array_equal(pre_n, post_n))
+        avail = out["outage"]["availability"]
+        out["availability_ok"] = (avail is not None
+                                  and avail >= out["availability_floor"])
+        out["degraded_served_during_outage"] = (
+            out["outage"]["degraded"] > 0 or not dur_exact)
+        with urllib.request.urlopen(base + "/stats", timeout=30) as r:
+            st = json.loads(r.read())
+        out["monitor"] = st["pod"]["monitor"]
+        out["health_after"] = {u: h["state"]
+                               for u, h in st["pod"]["health"].items()}
+        out["host_retries"] = {
+            u: h["retries"] for u, h in st["fanout"]["health"].items()}
+        out["degraded_responses_total"] = st["server"].get(
+            "knn_degraded_responses_total", 0)
+    finally:
+        fe.close()
+        for s in servers:
+            s.close()
+    return out
+
+
 def run_kernel_bench(*, dims=(3, 8, 64), n_points=8192, n_queries=1024,
                      k=16, bucket_size=128, reps=5, seed=0) -> dict:
     """Elementwise (VPU) vs MXU matmul-form traversal kernel at each D:
@@ -899,6 +1058,16 @@ def main(argv=None) -> int:
                     help="internal: run ONLY the routing bench in this "
                          "process (spawns its own pod processes) and "
                          "print its JSON")
+    ap.add_argument("--chaos-bench", action="store_true",
+                    help="also run the chaos bench (kill one routed host "
+                         "mid-load via a deterministic fault-injected "
+                         "outage, measure availability/degraded-rate, "
+                         "recovery time, and post-rejoin bitwise parity) "
+                         "in a subprocess and embed chaos_compare")
+    ap.add_argument("--chaos-child", action="store_true",
+                    help="internal: run ONLY the chaos bench in this "
+                         "process (needs its own 2-device fixture) and "
+                         "print its JSON")
     ap.add_argument("--kernel-bench", action="store_true",
                     help="also run the distance-kernel bench (elementwise "
                          "VPU vs MXU matmul-form at D in {3, 8, 64}) in a "
@@ -908,6 +1077,15 @@ def main(argv=None) -> int:
                          "process (1-device single-thread fixture) and "
                          "print its JSON")
     a = ap.parse_args(argv)
+
+    if a.chaos_child:
+        report = run_chaos_bench(
+            n_points=a.points, k=a.k, duration_s=a.duration,
+            concurrency=a.concurrency, batch=min(a.batch, 8),
+            max_delay_s=a.max_delay_ms / 1e3, seed=a.seed)
+        print(json.dumps(report, indent=2))
+        return 0 if (report.get("bitwise_parity_after_rejoin")
+                     and report.get("availability_ok")) else 1
 
     if a.kernel_child:
         report = run_kernel_bench(n_points=a.points, k=a.k, seed=a.seed)
@@ -1093,6 +1271,39 @@ def main(argv=None) -> int:
                 detail = (raw.decode(errors="replace")
                           if isinstance(raw, bytes) else str(raw))[-1500:]
             report["multihost_compare"] = {
+                "error": f"{str(e)[:300]} :: {detail}"}
+    if a.chaos_bench:
+        # same subprocess discipline: the chaos child pins a 2-device
+        # fixture and boots its own in-process routed pod. Availability
+        # under single-host loss AND post-rejoin bitwise parity both gate
+        # the exit code (the acceptance bar of the fault-tolerant serving
+        # issue); recovery_s and the per-phase availability/degraded-rate
+        # numbers are the BENCH series' trajectory data
+        try:
+            child = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--chaos-child",
+                 "--points", str(a.points), "--k", str(a.k),
+                 "--duration", str(a.duration),
+                 "--concurrency", str(a.concurrency),
+                 "--batch", str(a.batch),
+                 "--max-delay-ms", str(a.max_delay_ms),
+                 "--seed", str(a.seed)],
+                capture_output=True, text=True, env=env,
+                timeout=300 + a.duration * 10)
+            cc = json.loads(child.stdout)
+            report["chaos_compare"] = cc
+            if "error" not in cc:  # infra hiccups degrade, never gate
+                ok = (ok and bool(cc.get("bitwise_parity_after_rejoin"))
+                      and bool(cc.get("availability_ok")))
+        except (subprocess.TimeoutExpired, json.JSONDecodeError) as e:
+            if isinstance(e, json.JSONDecodeError):
+                detail = (child.stderr or child.stdout or "")[-1500:]
+            else:
+                raw = e.stderr or e.stdout or b""
+                detail = (raw.decode(errors="replace")
+                          if isinstance(raw, bytes) else str(raw))[-1500:]
+            report["chaos_compare"] = {
                 "error": f"{str(e)[:300]} :: {detail}"}
     if a.routing_bench:
         # same subprocess discipline: the routing child spawns its own pod
