@@ -1,0 +1,46 @@
+"""lskcheck: project-native static analysis for the serving stack.
+
+The stack's two load-bearing guarantees — bitwise parity across merge
+modes/hosts (the exact-kNN contract) and a threaded serving layer that
+survives host loss — were enforced only by runtime tests until this
+package. Nothing stopped a new ``time.time()`` in a fold path, an
+unguarded read of ``HostHealth`` state, or a silently-widened AOT bucket
+signature from landing. ``lskcheck`` turns those invariants into a
+machine-checked, CI-blocking form:
+
+- ``locks``       — the ``guarded_by("_lock")`` annotation convention for
+                    shared attributes, an AST checker proving every
+                    read/write of a guarded attribute happens inside the
+                    declared ``with self._lock`` block, and a lock-
+                    acquisition-order graph that flags potential
+                    inversions between threads.
+- ``determinism`` — bans wall-clock and unseeded RNG in deterministic
+                    paths, float ``==`` on distances, unstable sorts in
+                    tie-sensitive code, dict-iteration-order-dependent
+                    folds, and silent exception swallowing.
+- ``aot``         — ``jax.eval_shape``-traces every engine shape-bucket
+                    program on the CPU fixture and diffs the signature
+                    table against the committed ``docs/aot_contract.json``
+                    golden, catching recompile-risk and dtype drift
+                    without a TPU.
+
+Every suppression must be auditable: ``# lsk: allow[rule] reason``
+(analysis/waivers.py). Entry point: ``tools/lskcheck.py``; rule catalog:
+``docs/ANALYSIS.md``.
+
+This module stays import-light (no jax, no numpy) so serving code can
+import ``guarded_by`` for free.
+"""
+
+from mpi_cuda_largescaleknn_tpu.analysis.annotations import guarded_by
+
+__all__ = ["guarded_by", "run_repo"]
+
+
+def run_repo(*args, **kwargs):
+    """Lazy alias for :func:`analysis.runner.run_repo` (keeps the package
+    root import-light for the serving modules that only need
+    ``guarded_by``)."""
+    from mpi_cuda_largescaleknn_tpu.analysis.runner import run_repo as _run
+
+    return _run(*args, **kwargs)

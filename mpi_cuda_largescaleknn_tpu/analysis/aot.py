@@ -1,0 +1,179 @@
+"""AOT shape-bucket contract: trace every engine program, diff a golden.
+
+The serving engine's whole shape discipline (TPU-KNN, arXiv:2206.14286)
+is that a served shape can never silently retrace: programs are
+AOT-compiled per (engine, merge, qpad, query_buckets, score_dtype) key
+and ``compile_count`` is an honest counter. What that discipline does
+NOT catch by itself is drift in the programs' SIGNATURES — a widened
+operand dtype, an extra resident input, a reshaped output — which is
+recompile-risk and wire-format risk that only shows up under load or on
+a real TPU.
+
+This pass pins the full signature table: it builds small deterministic
+CPU fixture engines (2 mesh shards — no TPU needed), runs
+``jax.eval_shape`` over every shape-bucket program exactly as
+``_get_executable`` would build it, and diffs the resulting
+input/output aval table against the committed golden
+``docs/aot_contract.json``. Any difference — program added, program
+gone, signature changed, bucket geometry moved — is an ``aot-contract``
+finding. Intentional changes regenerate the golden
+(``python tools/lskcheck.py --write-aot-golden``) and the diff shows up
+in review as a JSON change, which is the point.
+
+Shapes depend only on the fixture constants below (never on point
+values, devices beyond the pinned mesh, or wall-clock), so the table is
+bit-stable across machines.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from mpi_cuda_largescaleknn_tpu.analysis.findings import Finding
+
+CONTRACT_RELPATH = os.path.join("docs", "aot_contract.json")
+CONTRACT_VERSION = 1
+
+#: fixture constants — part of the contract: changing any of them
+#: legitimately regenerates the golden
+FIXTURE = {"n_points": 192, "k": 4, "num_shards": 2,
+           "max_batch": 16, "min_batch": 8}
+
+#: engine configurations whose program families the contract pins: the
+#: serving matrix's load-bearing corners — host vs device merge, exact
+#: f32 vs MXU bf16 (high-D so the matmul path is actually taken), the
+#: routed candidates emission, and the flat engine
+CONFIGS = (
+    {"engine": "tiled", "merge": "host", "score_dtype": "f32", "dim": 3,
+     "emit": "final"},
+    {"engine": "tiled", "merge": "device", "score_dtype": "f32", "dim": 3,
+     "emit": "final"},
+    {"engine": "tiled", "merge": "device", "score_dtype": "bf16", "dim": 32,
+     "emit": "final"},
+    {"engine": "tiled", "merge": "device", "score_dtype": "f32", "dim": 3,
+     "emit": "candidates"},
+    {"engine": "bruteforce", "merge": "device", "score_dtype": "f32",
+     "dim": 3, "emit": "final"},
+)
+
+
+def fixture_points(n: int, dim: int) -> np.ndarray:
+    """Deterministic low-discrepancy points in [0, 1)^dim — a Weyl
+    sequence, so no RNG is involved at all (this module must satisfy its
+    own determinism rules)."""
+    i = np.arange(1, n * dim + 1, dtype=np.float64)
+    return ((i * 0.6180339887498949) % 1.0).reshape(
+        n, dim).astype(np.float32)
+
+
+def _aval_str(aval) -> str:
+    return f"{aval.dtype.name}[{','.join(str(d) for d in aval.shape)}]"
+
+
+def config_key(cfg: dict) -> str:
+    return (f"{cfg['engine']}|{cfg['merge']}|{cfg['score_dtype']}"
+            f"|d{cfg['dim']}|emit={cfg['emit']}")
+
+
+def trace_contract() -> dict:
+    """Build every fixture engine and eval_shape its program family."""
+    import jax
+
+    from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+    from mpi_cuda_largescaleknn_tpu.serve.engine import ResidentKnnEngine
+
+    mesh = get_mesh(FIXTURE["num_shards"])
+    out_configs = []
+    for cfg in CONFIGS:
+        pts = fixture_points(FIXTURE["n_points"], cfg["dim"])
+        engine = ResidentKnnEngine(
+            pts, FIXTURE["k"], mesh=mesh, engine=cfg["engine"],
+            merge=cfg["merge"], score_dtype=cfg["score_dtype"],
+            emit=cfg["emit"], max_batch=FIXTURE["max_batch"],
+            min_batch=FIXTURE["min_batch"])
+        programs = {}
+        for qpad in engine.shape_buckets:
+            qb = engine.query_buckets[qpad]
+            fn = engine._build_query_fn(engine.engine_name, qpad, qb)
+            args = engine._resident_args(engine.engine_name)
+            q0 = jax.ShapeDtypeStruct((qpad, engine.dim), np.float32)
+            out = jax.eval_shape(fn, *args, q0)
+            programs[f"q{qpad}|B{qb}"] = {
+                "in": [_aval_str(a) for a in args] + [_aval_str(q0)],
+                "out": [_aval_str(o) for o in out],
+            }
+        out_configs.append({
+            "key": config_key(cfg), **cfg,
+            "shape_buckets": list(engine.shape_buckets),
+            "query_buckets": {str(q): b for q, b in
+                              sorted(engine.query_buckets.items())},
+            "canonical_ties": engine.canonical_ties,
+            "score_mode": engine.score_mode,
+            "programs": programs,
+        })
+    return {"version": CONTRACT_VERSION, "fixture": dict(FIXTURE),
+            "configs": out_configs}
+
+
+def write_contract(contract: dict, path: str) -> None:
+    with open(path, "w") as fh:
+        json.dump(contract, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def diff_contract(contract: dict, golden_path: str) -> list[Finding]:
+    """Findings for every difference between the traced table and the
+    committed golden. The golden missing entirely is itself a finding —
+    the gate must fail loudly, not silently pass, on a fresh clone."""
+    rel = os.path.join("docs", "aot_contract.json")
+    if not os.path.exists(golden_path):
+        return [Finding("aot-contract", rel, 1,
+                        "golden contract file is missing — generate it "
+                        "with `python tools/lskcheck.py "
+                        "--write-aot-golden` and commit it")]
+    with open(golden_path) as fh:
+        golden = json.load(fh)
+    findings: list[Finding] = []
+
+    def emit(msg: str) -> None:
+        findings.append(Finding("aot-contract", rel, 1, msg))
+
+    if golden.get("version") != contract["version"]:
+        emit(f"contract version drifted: golden "
+             f"{golden.get('version')} vs traced {contract['version']}")
+    if golden.get("fixture") != contract["fixture"]:
+        emit(f"fixture constants drifted: golden {golden.get('fixture')} "
+             f"vs traced {contract['fixture']}")
+    gold_by_key = {c["key"]: c for c in golden.get("configs", ())}
+    new_by_key = {c["key"]: c for c in contract["configs"]}
+    for key in sorted(gold_by_key.keys() - new_by_key.keys()):
+        emit(f"engine config {key} is in the golden but no longer "
+             "traced — a serving configuration silently disappeared")
+    for key in sorted(new_by_key.keys() - gold_by_key.keys()):
+        emit(f"engine config {key} is traced but not in the golden — "
+             "regenerate the golden to adopt it")
+    for key in sorted(new_by_key.keys() & gold_by_key.keys()):
+        g, n = gold_by_key[key], new_by_key[key]
+        for fld in ("shape_buckets", "query_buckets", "canonical_ties",
+                    "score_mode"):
+            if g.get(fld) != n.get(fld):
+                emit(f"{key}: {fld} drifted: golden {g.get(fld)} vs "
+                     f"traced {n.get(fld)} — AOT bucket geometry changed")
+        gp, np_ = g.get("programs", {}), n.get("programs", {})
+        for pk in sorted(gp.keys() - np_.keys()):
+            emit(f"{key}: program {pk} gone — a shape bucket vanished "
+                 "(recompile risk for served shapes)")
+        for pk in sorted(np_.keys() - gp.keys()):
+            emit(f"{key}: program {pk} is new — regenerate the golden "
+                 "to adopt the bucket")
+        for pk in sorted(np_.keys() & gp.keys()):
+            for side in ("in", "out"):
+                if gp[pk].get(side) != np_[pk].get(side):
+                    emit(f"{key}: program {pk} {side!r} signature "
+                         f"drifted: golden {gp[pk].get(side)} vs traced "
+                         f"{np_[pk].get(side)} — dtype/shape drift in "
+                         "the AOT program contract")
+    return findings

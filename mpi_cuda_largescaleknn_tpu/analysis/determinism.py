@@ -1,0 +1,273 @@
+"""Determinism / parity rules over one module's AST.
+
+Everything here defends the stack's bitwise-parity contract (PAPER.md:
+exact kNN; TPU-KNN arXiv:2206.14286's fixed-shape numeric discipline):
+results must be a pure function of (index bytes, query bytes, config),
+never of wall-clock, RNG state, arrival order, or sort stability luck.
+
+Rules (ids in findings.RULES):
+
+- ``wallclock``       : ``time.time`` / ``time.time_ns`` /
+                        ``datetime.now|utcnow|today`` calls. Elapsed-time
+                        measurement belongs to ``perf_counter`` /
+                        ``monotonic``; schedule state belongs to an
+                        injectable clock (serve/health.py pattern) so
+                        tests drive it without sleeps.
+- ``rng-unseeded``    : module-level ``random.*`` calls (shared global
+                        state), no-arg ``random.Random()`` /
+                        ``np.random.default_rng()``, and the legacy
+                        ``np.random.*`` global generator.
+- ``float-eq``        : ``==`` / ``!=`` where an operand is
+                        distance-like (name matches ``d2|dist|kth|
+                        radius``) or a float literal. Exact bitwise tie
+                        detection is sometimes the CONTRACT (the
+                        canonical-ties fix) — those sites carry
+                        ``# lsk: allow[float-eq]`` waivers, which is the
+                        point: every float equality is auditable.
+- ``sort-unstable``   : ``np.sort``/``np.argsort`` over distance-like
+                        operands without ``kind='stable'``, and
+                        ``lax.sort`` over distance-like operands without
+                        ``is_stable=True`` unless it is a multi-key
+                        ``(dist2, id)`` sort (``num_keys >= 2`` — a total
+                        order needs no stability).
+- ``dict-order-fold`` : ``for`` over ``.keys()``/``.values()`` inside a
+                        fold/merge-named function — host folds must not
+                        depend on dict insertion (= arrival) order.
+- ``except-swallow``  : handler bodies that are only ``pass`` /
+                        ``continue`` for broad exception classes. Errors
+                        feed the ``*_errors`` counter pattern instead.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from mpi_cuda_largescaleknn_tpu.analysis.findings import Finding
+
+_DIST_RE = re.compile(r"(^|_)(d2|dsq|dist\w*|kth\w*|radius\w*)($|_)",
+                      re.IGNORECASE)
+_FOLD_FN_RE = re.compile(r"(fold|merge|reduce|assemble|combine)",
+                         re.IGNORECASE)
+
+#: random-module functions that consume the SHARED global stream
+_RANDOM_GLOBAL_FNS = {
+    "random", "randint", "randrange", "uniform", "choice", "choices",
+    "sample", "shuffle", "gauss", "normalvariate", "betavariate",
+    "expovariate", "triangular", "vonmisesvariate", "paretovariate",
+    "weibullvariate", "lognormvariate", "getrandbits", "seed",
+}
+
+#: legacy numpy global-RNG entry points (np.random.<fn>)
+_NP_RANDOM_GLOBAL_FNS = {
+    "rand", "randn", "randint", "random", "random_sample", "choice",
+    "shuffle", "permutation", "normal", "uniform", "seed", "standard_normal",
+    "exponential", "poisson", "beta", "gamma",
+}
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of an expression ('' when not a name)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _leaf_name(node: ast.AST) -> str:
+    """Last identifier of a Name/Attribute ('' otherwise)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _is_distance_like(node: ast.AST) -> bool:
+    name = _leaf_name(node)
+    return bool(name and _DIST_RE.search(name))
+
+
+def _kw(call: ast.Call, name: str) -> ast.expr | None:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+class _DeterminismVisitor(ast.NodeVisitor):
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: list[Finding] = []
+        self._fn_stack: list[str] = []
+
+    def _emit(self, rule: str, node: ast.AST, msg: str) -> None:
+        self.findings.append(Finding(rule, self.path, node.lineno, msg))
+
+    # --------------------------------------------------------------- scopes
+
+    def visit_FunctionDef(self, node):
+        self._fn_stack.append(node.name)
+        self.generic_visit(node)
+        self._fn_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    # ---------------------------------------------------------------- calls
+
+    def visit_Call(self, node: ast.Call):
+        dotted = _dotted(node.func)
+        # wallclock ---------------------------------------------------------
+        if dotted in ("time.time", "time.time_ns"):
+            self._emit("wallclock", node,
+                       f"{dotted}() in a deterministic/serving path — use "
+                       "time.perf_counter/monotonic for intervals or an "
+                       "injectable clock (serve/health.py) for schedules")
+        elif dotted.endswith((".now", ".utcnow", ".today")) and \
+                ("datetime" in dotted or "date" in dotted.split(".")[0]):
+            self._emit("wallclock", node,
+                       f"{dotted}() wall-clock read — results must not "
+                       "depend on the calendar")
+        # rng ---------------------------------------------------------------
+        if (isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "random"
+                and node.func.attr in _RANDOM_GLOBAL_FNS):
+            self._emit("rng-unseeded", node,
+                       f"random.{node.func.attr}() uses the shared global "
+                       "stream — construct random.Random(seed) per owner")
+        if dotted == "random.Random" and not node.args and not node.keywords:
+            self._emit("rng-unseeded", node,
+                       "random.Random() without a seed is "
+                       "os-entropy-seeded — pass an explicit seed")
+        if dotted.endswith("random.default_rng") and not node.args \
+                and not node.keywords:
+            self._emit("rng-unseeded", node,
+                       "np.random.default_rng() without a seed is "
+                       "os-entropy-seeded — pass an explicit seed")
+        if (isinstance(node.func, ast.Attribute)
+                and _dotted(node.func.value) in ("np.random", "numpy.random")
+                and node.func.attr in _NP_RANDOM_GLOBAL_FNS):
+            self._emit("rng-unseeded", node,
+                       f"np.random.{node.func.attr}() drives the legacy "
+                       "GLOBAL numpy generator — use "
+                       "np.random.default_rng(seed)")
+        # sorts -------------------------------------------------------------
+        self._check_sort(node, dotted)
+        self.generic_visit(node)
+
+    def _check_sort(self, node: ast.Call, dotted: str) -> None:
+        leaf = dotted.rsplit(".", 1)[-1] if dotted else ""
+        dist_args = any(_is_distance_like(a) for a in node.args) or any(
+            kw.arg is None and _is_distance_like(kw.value)
+            for kw in node.keywords)
+        # tuple operands: lax.sort takes ((d2, idx), ...)
+        for a in node.args:
+            if isinstance(a, (ast.Tuple, ast.List)):
+                dist_args = dist_args or any(_is_distance_like(e)
+                                             for e in a.elts)
+        if not dist_args:
+            return
+        if dotted.endswith(("np.argsort", "numpy.argsort")):
+            # plain np.sort of VALUES is order-deterministic whatever the
+            # algorithm; only argsort (ids ride along) is tie-sensitive
+            kind = _kw(node, "kind")
+            if not (isinstance(kind, ast.Constant)
+                    and kind.value == "stable"):
+                self._emit("sort-unstable", node,
+                           f"{leaf}() over distance-like data without "
+                           "kind='stable' — equal distances may reorder "
+                           "their ids between numpy versions/backends")
+        elif dotted.endswith("lax.sort"):
+            nk = _kw(node, "num_keys")
+            multi_key = (isinstance(nk, ast.Constant)
+                         and isinstance(nk.value, int) and nk.value >= 2)
+            stable = _kw(node, "is_stable")
+            is_stable = (isinstance(stable, ast.Constant)
+                         and stable.value is True)
+            if not (multi_key or is_stable):
+                self._emit("sort-unstable", node,
+                           "lax.sort over distance-like data is UNSTABLE "
+                           "by default — pass is_stable=True or sort the "
+                           "(dist2, id) pair with num_keys=2")
+
+    # ----------------------------------------------------------------- cmp
+
+    def visit_Compare(self, node: ast.Compare):
+        operands = [node.left] + list(node.comparators)
+        eq_ops = [op for op in node.ops if isinstance(op, (ast.Eq, ast.NotEq))]
+        if eq_ops:
+            # comparisons against strings/None are config checks, not
+            # numeric equality
+            benign = any(isinstance(o, ast.Constant)
+                         and (o.value is None or isinstance(o.value, str))
+                         for o in operands)
+            if not benign:
+                if any(_is_distance_like(o) for o in operands):
+                    self._emit("float-eq", node,
+                               "float equality on a distance-like value — "
+                               "bitwise tie checks must be deliberate "
+                               "(waive with a reason) and everything else "
+                               "should compare through the canonical "
+                               "(dist2, id) order")
+                elif any(isinstance(o, ast.Constant)
+                         and isinstance(o.value, float)
+                         for o in operands):
+                    self._emit("float-eq", node,
+                               "== / != against a float literal — exact "
+                               "float equality is rarely what serving "
+                               "code means")
+        self.generic_visit(node)
+
+    # ---------------------------------------------------------------- loops
+
+    def visit_For(self, node: ast.For):
+        in_fold = any(_FOLD_FN_RE.search(fn) for fn in self._fn_stack)
+        if in_fold and isinstance(node.iter, ast.Call):
+            fn = node.iter.func
+            if isinstance(fn, ast.Attribute) and fn.attr in ("keys",
+                                                             "values"):
+                self._emit("dict-order-fold", node,
+                           f"fold iterates .{fn.attr}() — dict order is "
+                           "insertion (= arrival) order; fold over "
+                           "sorted(...) or an index-ordered list so the "
+                           "result cannot depend on who answered first")
+        self.generic_visit(node)
+
+    # --------------------------------------------------------------- except
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler):
+        broad = node.type is None or (
+            isinstance(node.type, ast.Name)
+            and node.type.id in ("Exception", "BaseException"))
+        if broad and _is_silent_body(node.body):
+            what = ("bare except:" if node.type is None
+                    else f"except {node.type.id}:")
+            self._emit("except-swallow", node,
+                       f"{what} swallows the error silently — record it "
+                       "(last_error + *_errors counter, the PR-8 pattern) "
+                       "or narrow the exception type")
+        self.generic_visit(node)
+
+
+def _is_silent_body(body: list[ast.stmt]) -> bool:
+    """True when the handler does nothing observable: only pass/continue
+    (string-constant expressions count as comments)."""
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)):
+            continue
+        return False
+    return True
+
+
+def check_determinism(tree: ast.AST, path: str) -> list[Finding]:
+    v = _DeterminismVisitor(path)
+    v.visit(tree)
+    return v.findings

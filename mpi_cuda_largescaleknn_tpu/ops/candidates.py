@@ -87,8 +87,10 @@ def merge_candidates(state: CandidateState, cand_dist2: jnp.ndarray,
             # (d2 == inf ties need no fix: (inf, id>=0) never displaces the
             # init slots' (inf, -1) under the 2-key sort below.)
             kth = v[:, k - 1:k]
-            tied_lane = cand_dist2 == kth
-            tied_out = v == kth
+            # kth is an element of cand_dist2/v, so the boundary tie class
+            # is DEFINED by bitwise equality — deliberate float ==:
+            tied_lane = cand_dist2 == kth  # lsk: allow[float-eq] tie class
+            tied_out = v == kth  # lsk: allow[float-eq] tie class
             tcount = jnp.sum(tied_out, axis=1)
             needs = jnp.any((jnp.sum(tied_lane, axis=1) > tcount)
                             & jnp.isfinite(kth[:, 0]))

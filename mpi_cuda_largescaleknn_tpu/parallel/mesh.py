@@ -89,8 +89,8 @@ def initialize_distributed(coordinator: str | None = None,
             try:
                 jax.config.update("jax_cpu_collectives_implementation",
                                   "gloo")
-            except Exception:
-                pass  # older jax: option absent, collectives still default
+            except Exception:  # lsk: allow[except-swallow] compat probe:
+                pass  # older jax has no gloo option; collectives still default
         jax.distributed.initialize(coordinator_address=coordinator,
                                    num_processes=num_processes,
                                    process_id=process_id)

@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import threading
 
+from mpi_cuda_largescaleknn_tpu.analysis import guarded_by
+
 
 class OverloadError(RuntimeError):
     """Server at capacity — client should retry after ``retry_after_s``."""
@@ -54,9 +56,11 @@ class AdmissionController:
         self.max_queue_rows = int(max_queue_rows)
         self.default_timeout_s = float(default_timeout_s)
         self._lock = threading.Lock()
-        self._inflight_rows = 0
-        self.admitted = 0
-        self.rejected = 0
+        # shared across every handler thread: lskcheck proves each access
+        # happens under the declared lock (docs/ANALYSIS.md)
+        self._inflight_rows: guarded_by("_lock") = 0
+        self.admitted: guarded_by("_lock") = 0
+        self.rejected: guarded_by("_lock") = 0
         #: optional () -> int: rows currently dispatched on the device
         #: (batcher.inflight_rows); reported in stats, not used for capping
         self.pipeline_rows_fn = None
@@ -134,7 +138,7 @@ class GracefulQueryFn:
     def __init__(self, engine):
         self.engine = engine
         self._lock = threading.Lock()
-        self.failures = 0
+        self.failures: guarded_by("_lock") = 0
 
     def _degrade_or_raise(self, e: Exception, handle=None) -> None:
         """Record a failure; degrade if possible, else re-raise ``e``.

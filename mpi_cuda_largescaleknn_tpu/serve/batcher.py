@@ -54,6 +54,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from mpi_cuda_largescaleknn_tpu.analysis import guarded_by
 from mpi_cuda_largescaleknn_tpu.obs.timers import LatencyHistogram
 from mpi_cuda_largescaleknn_tpu.serve.admission import DeadlineExceeded
 
@@ -113,22 +114,24 @@ class DynamicBatcher:
                           and hasattr(query_fn, "dispatch")
                           and hasattr(query_fn, "complete"))
         self._cond = threading.Condition()
-        self._queue: deque[_Request] = deque()
-        self._queued_rows = 0
-        self._shutdown = False
-        # counters (under _cond)
-        self.batches = 0
-        self.rows_served = 0
-        self.rows_expired = 0
-        self.flush_full = 0
-        self.flush_deadline = 0
+        # queue + counters shared between submitter threads and the
+        # dispatch/completion workers: every access is under _cond
+        # (proven by lskcheck's guarded_by pass)
+        self._queue: guarded_by("_cond") = deque()
+        self._queued_rows: guarded_by("_cond") = 0
+        self._shutdown: guarded_by("_cond") = False
+        self.batches: guarded_by("_cond") = 0
+        self.rows_served: guarded_by("_cond") = 0
+        self.rows_expired: guarded_by("_cond") = 0
+        self.flush_full: guarded_by("_cond") = 0
+        self.flush_deadline: guarded_by("_cond") = 0
         # pipeline occupancy/stall accounting (under _cond); the stall
         # histogram shares the loadgen/server bucket geometry so the three
         # render identical /metrics buckets
-        self._inflight_batches = 0
-        self._inflight_rows = 0
-        self.dispatch_stalls = 0
-        self.dispatch_stall_seconds = 0.0
+        self._inflight_batches: guarded_by("_cond") = 0
+        self._inflight_rows: guarded_by("_cond") = 0
+        self.dispatch_stalls: guarded_by("_cond") = 0
+        self.dispatch_stall_seconds: guarded_by("_cond") = 0.0
         self.stall_hist = (timers.hist("pipeline_stall_seconds")
                            if timers is not None else LatencyHistogram())
         # time spent blocked inside query_fn.complete — for routed
@@ -343,9 +346,9 @@ class DynamicBatcher:
             with self._cond:
                 self._inflight_batches += 1
                 self._inflight_rows += len(merged)
+                inflight = self._inflight_batches
             if self._timers is not None:
-                self._timers.gauge("pipeline_inflight_batches",
-                                   self._inflight_batches)
+                self._timers.gauge("pipeline_inflight_batches", inflight)
             try:
                 t0 = time.perf_counter()
                 handle = self._query_fn.dispatch(merged)
@@ -354,10 +357,10 @@ class DynamicBatcher:
                 with self._cond:
                     self._inflight_batches -= 1
                     self._inflight_rows -= len(merged)
+                    inflight = self._inflight_batches
                     self._cond.notify_all()
                 if self._timers is not None:
-                    self._timers.gauge("pipeline_inflight_batches",
-                                       self._inflight_batches)
+                    self._timers.gauge("pipeline_inflight_batches", inflight)
                 self._slots.release()
                 continue
             self._inflight.put((live, len(merged), handle, t0))
@@ -390,12 +393,12 @@ class DynamicBatcher:
                 with self._cond:
                     self._inflight_batches -= 1
                     self._inflight_rows -= rows
+                    inflight = self._inflight_batches
                     # wake a dispatch worker parked on batch-while-busy: the
                     # device freed a slot, so a deadline flush is allowed now
                     self._cond.notify_all()
                 if self._timers is not None:
-                    self._timers.gauge("pipeline_inflight_batches",
-                                       self._inflight_batches)
+                    self._timers.gauge("pipeline_inflight_batches", inflight)
                 self._slots.release()
 
     # ------------------------------------------------------------------- admin

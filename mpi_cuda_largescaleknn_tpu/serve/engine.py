@@ -76,6 +76,7 @@ from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
+from mpi_cuda_largescaleknn_tpu.analysis import guarded_by
 from mpi_cuda_largescaleknn_tpu.core.types import PAD_SENTINEL
 from mpi_cuda_largescaleknn_tpu.models.sharding import (
     pad_and_flatten,
@@ -267,11 +268,27 @@ class ResidentKnnEngine:
         self.canonical_ties = (use_tiled
                                and self.id_offset + self.n_points < (1 << 24))
         self.timers = PhaseTimers()
-        self.compile_count = 0
-        self.degraded_reason: str | None = None
         self._lock = threading.Lock()
-        #: (engine_name, merge_mode, qpad) -> AOT executable
-        self._executables: dict = {}
+        # mutable engine identity: a mid-stream Pallas degradation
+        # (degrade()) swaps engine_name while dispatches and /stats
+        # scrapes run on other threads. The identity scalars live under
+        # their OWN small lock (never held across an XLA compile) so a
+        # /stats or /metrics scrape cannot block for the seconds-to-
+        # minutes _get_executable holds _lock while compiling a cold
+        # bucket (--no-warmup, post-degrade) — exactly when the health
+        # monitor's scrape/rejoin probes most need an answer. _lock
+        # still serializes dispatch/degrade/warmup, so identity reads
+        # inside a _lock region stay mutually consistent; acquisition
+        # order is always _lock -> _meta_lock (lskcheck-proven).
+        self._meta_lock = threading.Lock()
+        self.compile_count: guarded_by("_meta_lock") = 0
+        self.degraded_reason: guarded_by("_meta_lock") = None
+        self.engine_name: guarded_by("_meta_lock") = self.engine_name
+        #: qpad per published executable (the stats compiled_shapes list,
+        #: kept beside the scalars so scrapes never touch _executables)
+        self._compiled_shapes: guarded_by("_meta_lock") = []
+        #: (engine_name, merge_mode, qpad, B, score_dtype) -> AOT executable
+        self._executables: guarded_by("_lock") = {}
         # launch pool: ``dispatch`` hands the executable call here and
         # returns after staging, so the dispatch stage never blocks on
         # device compute — even on backends whose PJRT client executes
@@ -350,7 +367,8 @@ class ResidentKnnEngine:
         #: the buckets — the matmul expansion's precomputed norm term
         #: (ops/distance.py). Only materialized when the MXU score is on.
         self._bucket_norms2 = None
-        if self.score_mode == "mxu" and self.engine_name in (
+        # lsk: allow[lock-guard] _build_index runs from __init__ only —
+        if self.score_mode == "mxu" and self.engine_name in (  # unshared
                 "tiled", "pallas_tiled"):
             from mpi_cuda_largescaleknn_tpu.ops.distance import norms2
 
@@ -531,7 +549,7 @@ class ResidentKnnEngine:
         # shards this process fetches counts from (_tiles_fetch)
         return len(self._my_pos) * qpad * per_row
 
-    def _get_executable(self, qpad: int):
+    def _get_executable(self, qpad: int):  # lsk: holds[_lock]
         """AOT executable for (active engine, qpad); compiles on miss.
 
         ``compile_count`` increments EXACTLY when XLA is invoked — the
@@ -545,18 +563,22 @@ class ResidentKnnEngine:
         import jax
 
         qb = self.query_buckets[qpad]
-        key = (self.engine_name, self.merge_mode, qpad, qb, self.score_dtype)
+        with self._meta_lock:
+            engine_name = self.engine_name
+        key = (engine_name, self.merge_mode, qpad, qb, self.score_dtype)
         exe = self._executables.get(key)
         if exe is not None:
             return exe
         with self.timers.phase(f"compile_q{qpad}"):
-            fn = self._build_query_fn(self.engine_name, qpad, qb)
+            fn = self._build_query_fn(engine_name, qpad, qb)
             q0 = self._stage_replicated(
                 np.full((qpad, self.dim), PAD_SENTINEL, np.float32))
-            exe = fn.lower(*self._resident_args(self.engine_name),
+            exe = fn.lower(*self._resident_args(engine_name),
                            q0).compile()
-            self.compile_count += 1
         self._executables[key] = exe
+        with self._meta_lock:
+            self.compile_count += 1
+            self._compiled_shapes.append(qpad)
         return exe
 
     def warmup(self) -> dict:
@@ -573,6 +595,8 @@ class ResidentKnnEngine:
 
         per_bucket = {}
         with self._lock:
+            with self._meta_lock:
+                engine_name = self.engine_name
             for qpad in self.shape_buckets:
                 t0 = time.perf_counter()
                 exe = self._get_executable(qpad)
@@ -580,11 +604,10 @@ class ResidentKnnEngine:
                 # init; the traversal early-exits (no real queries)
                 q0 = self._stage_replicated(
                     np.full((qpad, self.dim), PAD_SENTINEL, np.float32))
-                out = exe(*self._resident_args(self.engine_name), q0)
+                out = exe(*self._resident_args(engine_name), q0)
                 jax.block_until_ready(out)
                 self._count_tiles(self._tiles_fetch(out[2]),
-                                  self._tiles_possible(self.engine_name,
-                                                       qpad))
+                                  self._tiles_possible(engine_name, qpad))
                 per_bucket[qpad] = round(time.perf_counter() - t0, 3)
         return {"per_bucket_s": per_bucket,
                 "query_buckets": dict(self.query_buckets),
@@ -618,7 +641,8 @@ class ResidentKnnEngine:
     # ----------------------------------------------------------------- degrade
 
     def can_degrade(self) -> bool:
-        return self.engine_name == "pallas_tiled"
+        with self._meta_lock:
+            return self.engine_name == "pallas_tiled"
 
     def degrade(self, reason: str) -> None:
         """Swap the Pallas traversal for its XLA twin after a runtime
@@ -632,11 +656,12 @@ class ResidentKnnEngine:
         disagrees with the executable it actually launched (the stale-handle
         replay in admission.GracefulQueryFn depends on that agreement)."""
         with self._lock:
-            if not self.can_degrade():
-                raise RuntimeError(
-                    f"engine '{self.engine_name}' has no fallback")
-            self.degraded_reason = reason
-            self.engine_name = "tiled"
+            with self._meta_lock:
+                if self.engine_name != "pallas_tiled":
+                    raise RuntimeError(
+                        f"engine '{self.engine_name}' has no fallback")
+                self.degraded_reason = reason
+                self.engine_name = "tiled"
             # the twin may want a different tuned bucket geometry, but the
             # index is already partitioned — keep the resident geometry,
             # stay exact
@@ -693,7 +718,9 @@ class ResidentKnnEngine:
         queries = np.asarray(queries, np.float32).reshape(-1, self.dim)
         n = len(queries)
         if n == 0:
-            return _InFlightBatch(queries, 0, 0, self.engine_name,
+            with self._meta_lock:
+                name = self.engine_name
+            return _InFlightBatch(queries, 0, 0, name,
                                   self.merge_mode, None, time.perf_counter())
         qpad = self.bucket_for(n)
         perm = None
@@ -704,7 +731,10 @@ class ResidentKnnEngine:
         staged = queries if perm is None else queries[perm]
         with self._lock:
             exe = self._get_executable(qpad)
-            engine_name = self.engine_name
+            with self._meta_lock:
+                # consistent with the key _get_executable compiled under:
+                # degrade() needs _lock, which this region holds
+                engine_name = self.engine_name
             args = self._resident_args(engine_name)
             q = np.full((qpad, self.dim), PAD_SENTINEL, np.float32)
             q[:n] = staged
@@ -891,16 +921,23 @@ class ResidentKnnEngine:
         return self.complete(self.dispatch(queries))
 
     def stats(self) -> dict:
-        # list() snapshots _executables atomically: a scrape may race a
-        # compile on the query path (--no-warmup, post-degrade), and bare
-        # dict iteration would raise "changed size during iteration"
+        # the mutable identity (engine_name / degraded_reason /
+        # compile_count / compiled shapes) is snapshotted under the small
+        # metadata lock: a scrape may race a compile or a degradation on
+        # the query path (--no-warmup, post-degrade) and must NOT queue
+        # behind _lock while _get_executable compiles a cold bucket
+        with self._meta_lock:
+            engine_name = self.engine_name
+            degraded_reason = self.degraded_reason
+            compile_count = self.compile_count
+            compiled_shapes = sorted(self._compiled_shapes)
         return {
-            "engine": self.engine_name,
+            "engine": engine_name,
             "merge": self.merge_mode,
             "score_dtype": self.score_dtype,
             "score_mode": self.score_mode,
             "dim": self.dim,
-            "degraded_reason": self.degraded_reason,
+            "degraded_reason": degraded_reason,
             "n_points": self.n_points,
             "k": self.k,
             "num_shards": self.num_shards,
@@ -924,8 +961,8 @@ class ResidentKnnEngine:
             "max_batch": self.max_batch,
             "bucket_size": self.bucket_size,
             "shape_buckets": list(self.shape_buckets),
-            "compiled_shapes": sorted(k[2] for k in list(self._executables)),
-            "compile_count": self.compile_count,
+            "compiled_shapes": compiled_shapes,
+            "compile_count": compile_count,
             # query-locality surface: per-shape bucket counts, whether the
             # Morton admission sort is on, and the traversal's cumulative
             # tile-skip accounting (the prune's win as a number)
@@ -981,7 +1018,8 @@ def _merge_shard_candidates(d2, idx, num_shards, qpad, k, full=False):
     # value, the stable sort would keep the first (k - m) in column order
     below = d2 < kth
     m = below.sum(axis=1, keepdims=True)
-    tied = d2 == kth
+    # lsk: allow[float-eq] the boundary tie-fix IS bitwise: kth is an element
+    tied = d2 == kth  # of d2, so exact equality finds exactly the tied class
     mask = below | (tied & (np.cumsum(tied, axis=1) <= k - m))
     # exactly k selected per row; recover them in ascending column order
     # with an O(R*k) boolean partition + an O(k log k) sort, never a full

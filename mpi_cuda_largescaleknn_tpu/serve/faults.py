@@ -44,6 +44,8 @@ import random
 import threading
 import time
 
+from mpi_cuda_largescaleknn_tpu.analysis import guarded_by
+
 FAULT_OPS = ("latency", "error", "drop", "close_mid_body")
 FAULTS_ENV = "KNN_FAULTS"
 
@@ -115,7 +117,10 @@ class FaultInjector:
 
     def __init__(self, specs: list[FaultSpec] | None = None):
         self._lock = threading.Lock()
-        self._specs: list[FaultSpec] = list(specs or [])
+        # the spec list AND each spec's firing state (seen/fires/_rng) are
+        # mutated under this lock — decide() is the only mutator and
+        # config() the only reader of spec counters, both locked below
+        self._specs: guarded_by("_lock") = list(specs or [])
 
     @classmethod
     def from_env(cls, env_var: str = FAULTS_ENV) -> "FaultInjector":

@@ -94,6 +94,7 @@ from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+from mpi_cuda_largescaleknn_tpu.analysis import guarded_by
 from mpi_cuda_largescaleknn_tpu.obs.timers import LatencyHistogram, PhaseTimers
 from mpi_cuda_largescaleknn_tpu.serve.admission import (
     AdmissionController,
@@ -180,7 +181,7 @@ class HostSliceServer(ThreadingHTTPServer):
         self._loop_entered = False
         self.metrics = ServingMetrics()
         self._seq_cond = threading.Condition()
-        self.next_seq = 0
+        self.next_seq: guarded_by("_seq_cond") = 0
         super().__init__(addr, _HostHandler)
 
     def serve_forever(self, poll_interval=0.5):
@@ -219,6 +220,14 @@ class HostSliceServer(ThreadingHTTPServer):
                 self._seq_cond.notify_all()
         return self.engine.complete_slices(handle)
 
+    def next_seq_snapshot(self) -> int:
+        """Locked read of the stream position for handler threads —
+        ``next_seq`` is guarded_by ``_seq_cond`` and the monitor's
+        replicate-mode seq-consensus reset reads it via /stats, so a
+        torn/stale read could spuriously defer a pod reset."""
+        with self._seq_cond:
+            return self.next_seq
+
     def run_routed(self, queries: np.ndarray):
         """Routed mode: dispatch a sub-batch in arrival order (the engine's
         own lock + FIFO launch pool serialize device entry; nothing is
@@ -244,13 +253,13 @@ class _HostHandler(JsonHttpHandler):
                              else "host-slice"),
                     "routing": srv.routing,
                     "process_index": srv.engine.process_index,
-                    "next_seq": srv.next_seq}
+                    "next_seq": srv.next_seq_snapshot()}
             self._send_json(200 if srv.ready else 503, body)
         elif path == "/stats":
             self._send_json(200, {"engine": srv.engine.stats(),
                                   "routing": srv.routing,
-                                  "next_seq": srv.next_seq,
-                                  "server": dict(srv.metrics.counters)})
+                                  "next_seq": srv.next_seq_snapshot(),
+                                  "server": srv.metrics.snapshot()})
         elif path == "/metrics":
             e = srv.engine.stats()
             lines = []
@@ -262,13 +271,13 @@ class _HostHandler(JsonHttpHandler):
                 lines += [f"# TYPE {name} counter", f"{name} {val}"]
             # server-side request counters (incl. the routed-row counter
             # knn_routed_rows_total in routed mode)
-            for name, val in sorted(srv.metrics.counters.items()):
+            for name, val in sorted(srv.metrics.snapshot().items()):
                 lines += [f"# TYPE {name} counter", f"{name} {val}"]
             for name, val in (("knn_ready", int(srv.ready)),
                               ("knn_compile_count", e["compile_count"]),
                               ("knn_num_shards", e["num_shards"]),
                               ("knn_host_process_index", e["process_index"]),
-                              ("knn_host_next_seq", srv.next_seq),
+                              ("knn_host_next_seq", srv.next_seq_snapshot()),
                               ("knn_host_row_offset", e["row_offset"]),
                               ("knn_host_routed",
                                int(srv.routing == "bounds"))):
@@ -432,11 +441,14 @@ class PodFanout:
                                      jitter=0.1, seed=0)
         self._sleep = time.sleep  # injectable: retry tests never sleep
         self.timers = timers if timers is not None else PhaseTimers()
-        self.broken: str | None = None
         self._lock = threading.Lock()
-        self._seq = 0
-        self.batches = 0
-        self.straggler_seconds = 0.0
+        # stream state + accounting shared between the batcher's dispatch/
+        # completion workers, handler threads (/stats), and the health
+        # monitor's reset path — all access under _lock (lskcheck-proven)
+        self.broken: guarded_by("_lock") = None
+        self._seq: guarded_by("_lock") = 0
+        self.batches: guarded_by("_lock") = 0
+        self.straggler_seconds: guarded_by("_lock") = 0.0
         self._tls = threading.local()
         # enough workers for `depth` batches x H hosts in flight
         self._pool = ThreadPoolExecutor(
@@ -462,7 +474,8 @@ class PodFanout:
         if c is not None:
             try:
                 c.close()
-            except Exception:  # noqa: BLE001 - teardown best-effort
+            # lsk: allow[except-swallow] teardown of an already-failed
+            except Exception:  # noqa: BLE001 - connection: nothing to record
                 pass
 
     def _post_shard(self, ep: _HostEndpoint, seq: int, body: bytes):
@@ -505,11 +518,14 @@ class PodFanout:
 
     def dispatch(self, queries: np.ndarray):
         """Fan one admitted batch out to every host (non-blocking)."""
-        if self.broken:
-            raise PodBrokenError(self.broken)
         q = np.ascontiguousarray(np.asarray(queries, np.float32)
                                  .reshape(-1, self.dim))
         with self._lock:
+            # broken-check and seq-assignment are ONE atomic step: a
+            # reset_stream racing between them could otherwise hand this
+            # batch a stale stream position
+            if self.broken:
+                raise PodBrokenError(self.broken)
             seq = self._seq
             self._seq += 1
         body = q.astype("<f4").tobytes()
@@ -607,6 +623,14 @@ class PodFanout:
                 out[ep.url] = {"error": msg}
         return out
 
+    def broken_reason(self) -> str | None:
+        """Locked read of the broken marker — the accessor cross-object
+        readers (handlers, the health monitor) use; the guarded_by
+        convention's self-rooted proof does not reach them, so they must
+        not touch ``.broken`` directly (docs/ANALYSIS.md)."""
+        with self._lock:
+            return self.broken
+
     def reset_stream(self, next_seq: int) -> None:
         """Clean-restart path (replicate mode): clear the broken marker and
         re-align the front end's sequence counter with the (restarted)
@@ -647,7 +671,7 @@ class PodFanout:
                              "retries": ep.retries,
                              "probe_errors": ep.probe_errors,
                              "scrape_errors": ep.scrape_errors,
-                             "state": ep.health.state,
+                             "state": health[ep.url]["state"],
                              "last_error": ep.last_error,
                              "latency": ep.latency.report()}
                     for ep in self.endpoints},
@@ -753,12 +777,12 @@ class RoutedPodFanout(PodFanout):
         self.bounds = bounds
         self.routing_mode = "bounds"
         self.cert_slack = routing_cert_slack(self.dim)
-        # routing accounting (under self._lock)
-        self.escalations = 0
-        self.escalation_waves = 0
-        self.degraded_rows = 0
-        self.host_loss_events = 0
-        self.hosts_per_query: Counter = Counter()
+        # routing accounting (under the inherited fan-out _lock)
+        self.escalations: guarded_by("_lock") = 0
+        self.escalation_waves: guarded_by("_lock") = 0
+        self.degraded_rows: guarded_by("_lock") = 0
+        self.host_loss_events: guarded_by("_lock") = 0
+        self.hosts_per_query: guarded_by("_lock") = Counter()
         for ep in self.endpoints:
             ep.routed_rows = 0
 
@@ -1035,7 +1059,7 @@ class FrontendServer(ThreadingHTTPServer):
         # pre-seed the failure-path counters so dashboards see zeros, not
         # missing series, before the first incident
         for name in ("knn_degraded_responses_total", "knn_unavailable_total"):
-            self.metrics.counters.setdefault(name, 0)
+            self.metrics.inc(name, 0)
         self.ready = False
         self.verbose = verbose
         self._loop_entered = False
@@ -1076,7 +1100,7 @@ class _FrontendHandler(JsonHttpHandler):
                 hosts = srv.fanout.probe_health()
             n_ok = sum(1 for h in hosts.values() if h.get("ok"))
             routed = getattr(srv.fanout, "routing_mode", "off") == "bounds"
-            broken = srv.fanout.broken
+            broken = srv.fanout.broken_reason()
             if broken or n_ok == 0 or not srv.ready:
                 status, code = ("broken" if broken else "degraded"), 503
             elif n_ok == len(hosts):
@@ -1099,7 +1123,7 @@ class _FrontendHandler(JsonHttpHandler):
                 "fanout": fan_stats,
                 "pod": {
                     "on_host_loss": srv.on_host_loss,
-                    "broken": srv.fanout.broken,
+                    "broken": fan_stats["broken"],
                     # same snapshot the fanout block embeds — taken once,
                     # so the two read paths can never diverge
                     "health": fan_stats["health"],
@@ -1108,7 +1132,7 @@ class _FrontendHandler(JsonHttpHandler):
                 },
                 "batcher": srv.batcher.stats(),
                 "admission": srv.admission.stats(),
-                "server": dict(srv.metrics.counters,
+                "server": dict(srv.metrics.snapshot(),
                                request_latency=srv.metrics.latency.report()),
                 "hosts": srv.fanout.scrape_host_stats(),
             })
@@ -1123,7 +1147,7 @@ class _FrontendHandler(JsonHttpHandler):
         f, b, a = (srv.fanout.stats(), srv.batcher.stats(),
                    srv.admission.stats())
         lines = []
-        for name, val in srv.metrics.counters.items():
+        for name, val in srv.metrics.snapshot().items():
             lines += [f"# TYPE {name} counter", f"{name} {val}"]
         for name, val in (
                 ("knn_fanout_batches_total", f["batches"]),
@@ -1310,9 +1334,13 @@ class _FrontendHandler(JsonHttpHandler):
 
 def wait_hosts_ready(host_urls: list[str], timeout_s: float = 600.0,
                      poll_s: float = 1.0) -> None:
-    """Block until every host's /healthz answers 200 (engines warmed)."""
+    """Block until every host's /healthz answers 200 (engines warmed).
+    A probe failure here is the EXPECTED state (still warming / not bound
+    yet), but it is recorded, not swallowed: the last error per host is
+    what the timeout message reports, so "not ready" is actionable."""
     deadline = time.monotonic() + timeout_s
     pending = list(host_urls)
+    last_err = "no probe answered"
     while pending:
         url = pending[0]
         try:
@@ -1321,10 +1349,12 @@ def wait_hosts_ready(host_urls: list[str], timeout_s: float = 600.0,
                 if r.status == 200:
                     pending.pop(0)
                     continue
-        except Exception:  # noqa: BLE001 - still warming / not bound yet
-            pass
+                last_err = f"healthz answered {r.status}"
+        except Exception as e:  # noqa: BLE001 - warming IS an answer here
+            last_err = f"{type(e).__name__}: {e}"
         if time.monotonic() > deadline:
-            raise TimeoutError(f"host {url} not ready after {timeout_s:.0f}s")
+            raise TimeoutError(f"host {url} not ready after "
+                               f"{timeout_s:.0f}s (last error: {last_err})")
         time.sleep(poll_s)
 
 

@@ -41,6 +41,8 @@ import time
 import urllib.request
 import zlib
 
+from mpi_cuda_largescaleknn_tpu.analysis import guarded_by
+
 STATES = ("healthy", "suspect", "drained", "rejoining")
 STATE_CODE = {s: i for i, s in enumerate(STATES)}
 
@@ -120,19 +122,23 @@ class HostHealth:
                                jitter=jitter, seed=seed)
         self._clock = clock
         self._lock = threading.Lock()
-        self.state = "healthy"
-        self.consecutive_failures = 0
-        self.last_error: str | None = None
-        self.last_probe_at: float | None = None
-        self.next_probe_at = 0.0  # due immediately
-        self.probe_attempt = 0  # drained-probe counter (backoff exponent)
-        self.drained_at: float | None = None
-        self._drained_seconds = 0.0
-        self.transitions = 0
+        # lifecycle state fed from BOTH the dispatch path and the monitor
+        # thread: every access goes through _lock (lskcheck-proven);
+        # external readers use snapshot()/is_drained()/drained_seconds()
+        self.state: guarded_by("_lock") = "healthy"
+        self.consecutive_failures: guarded_by("_lock") = 0
+        self.last_error: guarded_by("_lock") = None
+        self.last_probe_at: guarded_by("_lock") = None
+        self.next_probe_at: guarded_by("_lock") = 0.0  # due immediately
+        #: drained-probe counter (backoff exponent)
+        self.probe_attempt: guarded_by("_lock") = 0
+        self.drained_at: guarded_by("_lock") = None
+        self._drained_seconds: guarded_by("_lock") = 0.0
+        self.transitions: guarded_by("_lock") = 0
 
     # ------------------------------------------------------------ transitions
 
-    def _enter(self, state: str) -> None:
+    def _enter(self, state: str) -> None:  # lsk: holds[_lock]
         if state == self.state:
             return
         now = self._clock()
@@ -293,11 +299,14 @@ class HealthMonitor:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
-        self.probes = 0
-        self.rejoins = 0
-        self.rejoin_rejections = 0
-        self.stream_resets = 0
-        self.events: list[str] = []  # bounded transition log (stats/debug)
+        # monitor counters are read by /stats scrapes while check_once
+        # runs on the monitor thread
+        self.probes: guarded_by("_lock") = 0
+        self.rejoins: guarded_by("_lock") = 0
+        self.rejoin_rejections: guarded_by("_lock") = 0
+        self.stream_resets: guarded_by("_lock") = 0
+        #: bounded transition log (stats/debug)
+        self.events: guarded_by("_lock") = []
 
     # ----------------------------------------------------------------- driver
 
@@ -354,8 +363,7 @@ class HealthMonitor:
                 if ok:
                     h.mark_rejoining()
                     if (self.mode == "off"
-                            and getattr(self.fanout, "broken", None)
-                            is not None):
+                            and self._fanout_broken() is not None):
                         # the broken replicate stream rejoins pod-wide
                         # (below); the host stays rejoining until the
                         # whole pod resets
@@ -372,6 +380,15 @@ class HealthMonitor:
             h.schedule_next_probe(key=ep.url, now=now)
         if self.mode == "off":
             self._try_pod_reset(probe_ok)
+
+    def _fanout_broken(self) -> str | None:
+        """The fan-out's broken marker through its LOCKED accessor —
+        ``broken`` is guarded_by the fan-out's lock, and the monitor
+        thread is exactly the kind of cross-thread reader the guard
+        exists for (plain fakes in tests may lack the accessor)."""
+        fn = getattr(self.fanout, "broken_reason", None)
+        return fn() if fn is not None else getattr(self.fanout, "broken",
+                                                   None)
 
     def _try_rejoin(self, ep) -> bool:
         """Routed-mode rejoin: revalidate the host's config/bounds
@@ -410,7 +427,7 @@ class HealthMonitor:
         was actually due for a probe this cycle, so a long outage costs
         the drained hosts' capped-exponential cadence, not one full pod
         probe + stats scrape per poll tick."""
-        if getattr(self.fanout, "broken", None) is None or not probe_ok:
+        if self._fanout_broken() is None or not probe_ok:
             return
         seqs = []
         for ep in self.fanout.endpoints:

@@ -29,6 +29,7 @@ from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+from mpi_cuda_largescaleknn_tpu.analysis import guarded_by
 from mpi_cuda_largescaleknn_tpu.obs.timers import LatencyHistogram
 from mpi_cuda_largescaleknn_tpu.serve.admission import (
     AdmissionController,
@@ -77,10 +78,20 @@ def parse_knn_body(path: str, headers, rfile, dim: int = 3):
 class ServingMetrics:
     def __init__(self):
         self._lock = threading.Lock()
-        self.counters = {"knn_requests_total": 0, "knn_rows_total": 0,
-                         "knn_overload_total": 0, "knn_deadline_total": 0,
-                         "knn_badrequest_total": 0, "knn_error_total": 0}
+        # increments come from every handler thread; readers (the /stats
+        # and /metrics renderers) take dict(...) copies — a point-in-time
+        # copy of int counters is the intended snapshot semantics
+        self.counters: guarded_by("_lock") = {
+            "knn_requests_total": 0, "knn_rows_total": 0,
+            "knn_overload_total": 0, "knn_deadline_total": 0,
+            "knn_badrequest_total": 0, "knn_error_total": 0}
         self.latency = LatencyHistogram()
+
+    def snapshot(self) -> dict:
+        """Locked point-in-time copy — what cross-object readers use
+        (the guarded_by proof is self-rooted; see docs/ANALYSIS.md)."""
+        with self._lock:
+            return dict(self.counters)
 
     def inc(self, name: str, by: int = 1):
         with self._lock:
@@ -192,7 +203,7 @@ class _Handler(JsonHttpHandler):
                 "engine": srv.engine.stats(),
                 "batcher": srv.batcher.stats(),
                 "admission": srv.admission.stats(),
-                "server": dict(srv.metrics.counters,
+                "server": dict(srv.metrics.snapshot(),
                                request_latency=srv.metrics.latency.report()),
             })
         elif path == "/metrics":
@@ -205,7 +216,7 @@ class _Handler(JsonHttpHandler):
     def _prometheus(srv: KnnServer) -> str:
         e, b, a = srv.engine.stats(), srv.batcher.stats(), srv.admission.stats()
         lines = []
-        for name, val in srv.metrics.counters.items():
+        for name, val in srv.metrics.snapshot().items():
             lines += [f"# TYPE {name} counter", f"{name} {val}"]
         # engine-side cumulative counters: bytes fetched across the host
         # link and result rows completed — the device-vs-host merge

@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""lskcheck — the repo's static-analysis gate (blocking in tier-1 CI).
+
+Runs three pass families over the package + tools (see docs/ANALYSIS.md):
+
+  lock discipline   guarded_by("_lock") attribute proofs + a lock-
+                    acquisition-order graph (deadlock cycles)
+  determinism       wall-clock / unseeded RNG / float == on distances /
+                    unstable sorts / dict-order folds / swallowed errors
+  AOT contract      jax.eval_shape trace of every engine shape-bucket
+                    program diffed against docs/aot_contract.json
+
+Exit status is 0 iff there are ZERO unwaived findings and no contract
+drift. Suppressions must be auditable: `# lsk: allow[rule] reason`.
+
+Usage:
+  python tools/lskcheck.py                      # full gate
+  python tools/lskcheck.py --no-aot             # fast AST-only run
+  python tools/lskcheck.py --json ANALYSIS.json # machine-readable report
+  python tools/lskcheck.py --write-aot-golden   # adopt AOT drift
+  python tools/lskcheck.py --list-rules
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+# CPU pinning must precede the first jax import (the AOT pass builds
+# fixture engines on a 2-device host-platform mesh; the accelerator
+# tunnel must never be dialed from a lint gate) — same hardening as
+# tests/conftest.py
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=2").strip()
+
+
+def main(argv: list[str] | None = None) -> int:
+    from mpi_cuda_largescaleknn_tpu.analysis.findings import RULES
+    from mpi_cuda_largescaleknn_tpu.analysis.runner import (
+        DEFAULT_ROOTS,
+        run_repo,
+    )
+
+    ap = argparse.ArgumentParser(
+        prog="lskcheck", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("roots", nargs="*", default=list(DEFAULT_ROOTS),
+                    help="files/directories to analyze (repo-relative; "
+                         f"default: {' '.join(DEFAULT_ROOTS)})")
+    ap.add_argument("--json", metavar="PATH", default=None,
+                    help="write the machine-readable report (the CI "
+                         "ANALYSIS.json artifact)")
+    ap.add_argument("--no-aot", action="store_true",
+                    help="skip the AOT-contract trace (AST passes only; "
+                         "no jax import)")
+    ap.add_argument("--write-aot-golden", action="store_true",
+                    help="regenerate docs/aot_contract.json from the "
+                         "traced programs instead of diffing")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="only the summary line, no per-finding output")
+    args = ap.parse_args(argv)
+
+    if args.no_aot and args.write_aot_golden:
+        ap.error("--write-aot-golden requires the AOT trace; "
+                 "drop --no-aot")
+
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule:16s} {desc}")
+        return 0
+
+    report = run_repo(roots=tuple(args.roots), base=_REPO,
+                      aot=not args.no_aot,
+                      aot_update=args.write_aot_golden)
+    if args.json:
+        report.dump_json(args.json)
+
+    if not args.quiet:
+        for f in sorted(report.findings,
+                        key=lambda f: (f.path, f.line, f.rule)):
+            print(f.render())
+    s = report.summary()
+    waived = s["waived"]
+    print(f"lskcheck: {s['files_checked']} files, "
+          f"{report.aot_programs} AOT programs, "
+          f"{s['findings']} finding(s), {waived} waived"
+          + (f" — per-rule {s['per_rule']}" if s["per_rule"] else "")
+          + (" — OK" if s["ok"] else " — FAIL"))
+    if args.write_aot_golden:
+        print("wrote docs/aot_contract.json")
+    return 0 if s["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

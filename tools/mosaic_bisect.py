@@ -19,7 +19,7 @@ def run_case(name, body):
     def kernel(x_ref, o_ref):
         o_ref[:] = body(x_ref[:])
 
-    t0 = time.time()
+    t0 = time.perf_counter()
     try:
         x = jnp.arange(S * T, dtype=jnp.float32).reshape(S, T) % 37.0
         out = pl.pallas_call(
@@ -29,11 +29,11 @@ def run_case(name, body):
             out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         )(x)
         out.block_until_ready()
-        print(json.dumps({name: "ok", "s": round(time.time() - t0, 1)}),
+        print(json.dumps({name: "ok", "s": round(time.perf_counter() - t0, 1)}),
               flush=True)
     except Exception as e:  # noqa: BLE001
         print(json.dumps({name: f"{type(e).__name__}: {e}"[:300],
-                          "s": round(time.time() - t0, 1)}), flush=True)
+                          "s": round(time.perf_counter() - t0, 1)}), flush=True)
 
 
 def case_min(d2):
@@ -58,7 +58,8 @@ def case_i32_row_bcast_s64(d2):
 def case_lane_extract(d2):
     lane = jax.lax.broadcasted_iota(jnp.int32, (S, T), 1)
     m = jnp.min(d2, axis=1)
-    is_min = d2 == m[:, None]
+    # lsk: allow[float-eq] min-lane extraction repro: m IS an element of d2,
+    is_min = d2 == m[:, None]  # so bitwise equality is exact by construction
     ml = jnp.min(jnp.where(is_min, lane, T), axis=1)
     sel = is_min & (lane == ml[:, None])
     mid = jnp.max(jnp.where(sel, lane, _NEG_BIG), axis=1)
@@ -90,6 +91,7 @@ def case_while(d2):
         _, d2, cd2 = c
         m = jnp.min(d2, axis=1)
         improved = m < cd2[:, -1]
+        # lsk: allow[float-eq] m is jnp.min(d2): equality is exact by construction
         d2 = jnp.where((d2 == m[:, None]) & improved[:, None], jnp.inf, d2)
         cd2 = jnp.where(improved[:, None], jnp.minimum(cd2, m[:, None]), cd2)
         go = jnp.any(jnp.min(d2, axis=1) < cd2[:, -1])

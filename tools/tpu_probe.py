@@ -29,14 +29,14 @@ REPORT = {}
 
 def stage(name):
     def deco(fn):
-        t0 = time.time()
+        t0 = time.perf_counter()
         try:
             out = fn()
-            REPORT[name] = {"ok": True, "seconds": round(time.time() - t0, 1),
+            REPORT[name] = {"ok": True, "seconds": round(time.perf_counter() - t0, 1),
                             **(out or {})}
         except Exception as e:  # noqa: BLE001
             REPORT[name] = {"ok": False,
-                            "seconds": round(time.time() - t0, 1),
+                            "seconds": round(time.perf_counter() - t0, 1),
                             "error": f"{type(e).__name__}: {e}"[:800]}
             traceback.print_exc()
         print("STAGE " + json.dumps({name: REPORT[name]}), flush=True)
@@ -47,7 +47,7 @@ def stage(name):
 def main():
     import numpy as np
 
-    t0 = time.time()
+    t0 = time.perf_counter()
     import jax
 
     @stage("contact")
@@ -55,7 +55,7 @@ def main():
         d = jax.devices()
         return {"platform": d[0].platform, "n_devices": len(d),
                 "device": str(d[0]),
-                "import_plus_devices_s": round(time.time() - t0, 1)}
+                "import_plus_devices_s": round(time.perf_counter() - t0, 1)}
 
     on_tpu = REPORT["contact"].get("ok") and \
         REPORT["contact"].get("platform") not in (None, "cpu")
@@ -65,11 +65,11 @@ def main():
         import jax.numpy as jnp
         x = jnp.ones((1024, 1024), jnp.float32)
         y = (x @ x).block_until_ready()
-        t1 = time.time()
+        t1 = time.perf_counter()
         for _ in range(10):
             y = (y @ x) / 1024.0
         y.block_until_ready()
-        return {"ten_matmuls_s": round(time.time() - t1, 4),
+        return {"ten_matmuls_s": round(time.perf_counter() - t1, 4),
                 "check": float(y[0, 0])}
 
     @stage("pallas_bf")
@@ -82,23 +82,23 @@ def main():
         q = rng.random((1024, 3)).astype(np.float32)
         p = rng.random((4096, 3)).astype(np.float32)
         st = init_candidates(1024, 8)
-        t1 = time.time()
+        t1 = time.perf_counter()
         out = knn_update_pallas(st, q, p, query_tile=256, point_tile=2048,
                                 interpret=not on_tpu)
         out.dist2.block_until_ready()
-        compile_s = time.time() - t1
+        compile_s = time.perf_counter() - t1
         # correctness vs brute force on the first 4 queries
         d2 = ((q[:4, None, :] - p[None, :, :]) ** 2).sum(-1)
         ref = np.sort(d2, axis=1)[:, :8]
         got = np.asarray(out.dist2[:4])
         assert np.allclose(np.sort(got, axis=1), ref, rtol=1e-5, atol=1e-6), \
             (got, ref)
-        t2 = time.time()
+        t2 = time.perf_counter()
         out = knn_update_pallas(st, q, p, query_tile=256, point_tile=2048,
                                 interpret=not on_tpu)
         out.dist2.block_until_ready()
         return {"compile_s": round(compile_s, 2),
-                "steady_s": round(time.time() - t2, 4)}
+                "steady_s": round(time.perf_counter() - t2, 4)}
 
     @stage("pallas_tiled")
     def _tiled():
@@ -112,18 +112,18 @@ def main():
         pts = rng.random((8192, 3)).astype(np.float32)
         q = partition_points(pts, bucket_size=256)
         st = init_candidates(q.num_buckets * q.bucket_size, 8)
-        t1 = time.time()
+        t1 = time.perf_counter()
         out = knn_update_tiled_pallas(st, q, q, interpret=not on_tpu)
         out.dist2.block_until_ready()
-        compile_s = time.time() - t1
+        compile_s = time.perf_counter() - t1
         ref = knn_update_tiled(st, q, q)
         assert np.allclose(np.asarray(out.dist2), np.asarray(ref.dist2),
                            rtol=1e-5, atol=1e-6)
-        t2 = time.time()
+        t2 = time.perf_counter()
         out = knn_update_tiled_pallas(st, q, q, interpret=not on_tpu)
         out.dist2.block_until_ready()
         return {"compile_s": round(compile_s, 2),
-                "steady_s": round(time.time() - t2, 4)}
+                "steady_s": round(time.perf_counter() - t2, 4)}
 
     @stage("pallas_warm_group")
     def _warm_group():
@@ -147,11 +147,11 @@ def main():
             q = partition_points(pts, bucket_size=64)
             pc = coarsen_buckets(q, 8)           # T = 512 lanes
             cold = init_candidates(q.num_buckets * q.bucket_size, k)
-            t1 = time.time()
+            t1 = time.perf_counter()
             ref, vis_c, pas_c = knn_update_tiled_pallas(
                 cold, q, pc, with_stats="full", interpret=not on_tpu)
             vis_c.block_until_ready()
-            compile_s = time.time() - t1
+            compile_s = time.perf_counter() - t1
             warm0 = warm_start_self(pc, k)
             got, vis_w, pas_w = knn_update_tiled_pallas(
                 warm0, q, pc, skip_self=jnp.int32(1), self_group=8,
