@@ -84,6 +84,15 @@ answer over POST /shard_knn to the pod front end
                     need a spatially-ordered input file (the io
                     partitioner's Morton order); an unordered file stays
                     exact but routes every query everywhere
+  --standby         routed mode only: start as a WARM STANDBY — load no
+                    slab, build no engine, and wait for the pod front
+                    end's replica manager to direct an adoption
+                    (POST /adopt_slab). The standby then materializes the
+                    named slab from this process's input file (or pulls
+                    it from a surviving replica), AOT-warms every shape
+                    bucket, and serves it — fingerprint-gated by the
+                    front end before any query routes here
+                    (docs/SERVING.md "Replication & slab handoff")
 """
 
 
@@ -104,7 +113,7 @@ def parse_serve_args(argv: list[str]) -> dict:
            "timeout_ms": 5000.0, "warmup": True, "timings": False,
            "verbose": False,
            "coordinator": None, "num_hosts": 1, "host_id": 0,
-           "routing": "off"}
+           "routing": "off", "standby": False}
     i = 0
     try:
         while i < len(argv):
@@ -153,6 +162,8 @@ def parse_serve_args(argv: list[str]) -> dict:
                 i += 1; opt["host_id"] = int(argv[i])
             elif arg == "--routing":
                 i += 1; opt["routing"] = argv[i]
+            elif arg == "--standby":
+                opt["standby"] = True
             elif arg == "--no-warmup":
                 opt["warmup"] = False
             elif arg == "--timings":
@@ -174,6 +185,9 @@ def parse_serve_args(argv: list[str]) -> dict:
         usage("--routing bounds hosts are independent processes — they "
               "never join a global mesh, so --coordinator is a config "
               "error (use --routing off for the pod-collective mode)")
+    if opt["standby"] and opt["routing"] != "bounds":
+        usage("--standby is the routed tier's slab-handoff target — "
+              "launch with --routing bounds")
     return opt
 
 
@@ -200,53 +214,76 @@ def main(argv: list[str] | None = None) -> int:
         initialize_distributed(opt["coordinator"], opt["num_hosts"],
                                opt["host_id"])
 
+    if routed and opt["standby"]:
+        # warm standby (slab handoff): no slab, no engine — record the
+        # engine-construction knobs and wait for POST /adopt_slab from
+        # the front end's replica manager (serve/replica.py)
+        from mpi_cuda_largescaleknn_tpu.serve.frontend import HostSliceServer
+
+        standby_config = {
+            "path": opt["in_path"], "num_hosts": opt["num_hosts"],
+            "k": opt["k"], "shards": opt["shards"],
+            "engine": opt["engine"], "merge": opt["merge"],
+            "bucket_size": opt["bucket_size"],
+            "max_radius": opt["max_radius"],
+            "max_batch": opt["max_batch"], "min_batch": opt["min_batch"],
+            "query_buckets": opt["query_buckets"],
+            "score_dtype": opt["score_dtype"]}
+        server = HostSliceServer((opt["host"], opt["port"]), None,
+                                 routing="bounds",
+                                 standby_config=standby_config,
+                                 verbose=opt["verbose"])
+        host, port = server.server_address[:2]
+        print(f"standby host on http://{host}:{port} — no slab adopted "
+              f"yet; waiting for POST /adopt_slab ({opt['in_path']}, "
+              f"{opt['num_hosts']} slabs)")
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            print("shutting down")
+        finally:
+            server.close()
+        return 0
+
     id_offset = 0
     if routed:
         # shard-local routing: this process owns ONE row slab of the index
         # and serves it independently — no global mesh, global neighbor
         # ids via the engine's id offset, full candidate rows emitted for
-        # the front end's cross-host fold (serve/frontend.py). Only the
-        # slab is MATERIALIZED: routed hosts exist so each box holds 1/H
-        # of the index, so a whole-file read would defeat the point
+        # the front end's cross-host fold. Only the slab is MATERIALIZED
+        # (serve/engine.py materialize_slab_engine — the same path the
+        # slab handoff's /adopt_slab uses): routed hosts exist so each
+        # box holds 1/H of the index, so a whole-file read would defeat
+        # the point
+        from mpi_cuda_largescaleknn_tpu.serve.engine import (
+            materialize_slab_engine,
+        )
+
         if not (0 <= opt["host_id"] < opt["num_hosts"]):
             usage(f"--host-id {opt['host_id']} outside [0, "
                   f"{opt['num_hosts']})")
-        if opt["in_path"].endswith(".npy"):
-            import numpy as np
-
-            from mpi_cuda_largescaleknn_tpu.models.sharding import (
-                slab_bounds,
-            )
-
-            arr = np.load(opt["in_path"], mmap_mode="r")
-            n_total = len(arr)
-            id_offset, end = slab_bounds(n_total, opt["num_hosts"])[
-                opt["host_id"]]
-            points = np.asarray(arr[id_offset:end], np.float32)
-        else:
-            # the reference's readFilePortion split — identical integer
-            # arithmetic to slab_bounds, so slabs tile [0, N) exactly
-            from mpi_cuda_largescaleknn_tpu.io.reader import (
-                read_file_portion,
-            )
-
-            points, id_offset, n_total = read_file_portion(
-                opt["in_path"], opt["host_id"], opt["num_hosts"])
+        engine, id_offset, n_total = materialize_slab_engine(
+            opt["in_path"], opt["host_id"], opt["num_hosts"],
+            k=opt["k"], shards=opt["shards"], engine=opt["engine"],
+            merge=opt["merge"], bucket_size=opt["bucket_size"],
+            max_radius=opt["max_radius"], max_batch=opt["max_batch"],
+            min_batch=opt["min_batch"],
+            query_buckets=opt["query_buckets"],
+            score_dtype=opt["score_dtype"])
         print(f"routed host {opt['host_id']}/{opt['num_hosts']}: loaded "
-              f"rows [{id_offset}:{id_offset + len(points)}) of {n_total} "
-              f"from {opt['in_path']}")
+              f"rows [{id_offset}:{id_offset + engine.n_points}) of "
+              f"{n_total} from {opt['in_path']}")
     else:
         points = read_points(opt["in_path"])
         n_total = len(points)
         print(f"loaded {len(points)} points from {opt['in_path']}")
-    engine = ResidentKnnEngine(
-        points, opt["k"], mesh=get_mesh(opt["shards"]),
-        engine=opt["engine"], bucket_size=opt["bucket_size"],
-        max_radius=opt["max_radius"], max_batch=opt["max_batch"],
-        min_batch=opt["min_batch"], merge=opt["merge"],
-        query_buckets=opt["query_buckets"],
-        score_dtype=opt["score_dtype"],
-        id_offset=id_offset, emit="candidates" if routed else "final")
+        engine = ResidentKnnEngine(
+            points, opt["k"], mesh=get_mesh(opt["shards"]),
+            engine=opt["engine"], bucket_size=opt["bucket_size"],
+            max_radius=opt["max_radius"], max_batch=opt["max_batch"],
+            min_batch=opt["min_batch"], merge=opt["merge"],
+            query_buckets=opt["query_buckets"],
+            score_dtype=opt["score_dtype"])
 
     if opt["num_hosts"] > 1 or routed:
         from mpi_cuda_largescaleknn_tpu.serve.frontend import HostSliceServer

@@ -180,6 +180,13 @@ class ResidentKnnEngine:
         #: rows — a PARTIAL result the routed front end folds across hosts
         #: (``complete_candidates``)
         self.emit = emit
+        #: routed slab engines keep a host-side reference to their rows
+        #: (a reference to the caller's array, not a copy — the slab is
+        #: 1/H of the index, already resident in host RAM from loading):
+        #: the slab-handoff pull path serves these on GET /slab_rows so a
+        #: warm standby can adopt the slab from a surviving replica
+        #: instead of re-reading the source file (serve/replica.py)
+        self.host_points = points if emit == "candidates" else None
         #: point dimensionality — the whole ops/io/serve stack is D-generic
         #: (the matmul-form scorer is what makes high D affordable); only
         #: the Morton admission sort is 3-D-specific and disables itself
@@ -984,6 +991,65 @@ class ResidentKnnEngine:
             "result_rows": self.timers.counter("result_rows"),
             "timers": self.timers.report(),
         }
+
+
+def materialize_slab_engine(path, host_id: int, num_hosts: int, *, k: int,
+                            shards=None, engine: str = "auto",
+                            merge: str = "auto", bucket_size: int = 0,
+                            max_radius: float = math.inf,
+                            max_batch: int = 1024, min_batch: int = 8,
+                            query_buckets: int = 0,
+                            score_dtype: str = "f32", points=None,
+                            id_offset: int | None = None,
+                            warmup: bool = False):
+    """Load row slab ``[N*i/H, N*(i+1)/H)`` and build its routed engine.
+
+    The ONE slab-upload + AOT-warmup path shared by ``serve_main
+    --routing bounds`` hosts at launch and by the standby's
+    ``POST /adopt_slab`` handoff (serve/frontend.py): both must
+    materialize byte-identical slabs — the reference's
+    ``read_file_portion`` split for ``.float3`` (identical integer
+    arithmetic to ``slab_bounds``, so the adopted rows equal the lost
+    host's exactly), an mmap slice for ``.npy``. Pass ``points`` +
+    ``id_offset`` to skip the file read (the pull-from-replica path —
+    serve/replica.py ``pull_slab_rows``). Returns
+    ``(engine, id_offset, n_total)`` with ``n_total`` None when the rows
+    came pre-loaded."""
+    from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+
+    if points is None:
+        if not path:
+            raise ValueError("need an input path or pre-loaded slab rows")
+        if path.endswith(".npy"):
+            from mpi_cuda_largescaleknn_tpu.models.sharding import (
+                slab_bounds,
+            )
+
+            arr = np.load(path, mmap_mode="r")
+            n_total = len(arr)
+            id_offset, end = slab_bounds(n_total, num_hosts)[host_id]
+            points = np.asarray(arr[id_offset:end], np.float32)
+        else:
+            from mpi_cuda_largescaleknn_tpu.io.reader import (
+                read_file_portion,
+            )
+
+            points, id_offset, n_total = read_file_portion(
+                path, host_id, num_hosts)
+    else:
+        if id_offset is None:
+            raise ValueError("pre-loaded slab rows need their id_offset "
+                             "(the slab's global row origin)")
+        n_total = None
+    eng = ResidentKnnEngine(
+        points, k, mesh=get_mesh(shards), engine=engine, merge=merge,
+        bucket_size=bucket_size, max_radius=max_radius,
+        max_batch=max_batch, min_batch=min_batch,
+        query_buckets=query_buckets, score_dtype=score_dtype,
+        id_offset=int(id_offset), emit="candidates")
+    if warmup:
+        eng.warmup()
+    return eng, int(id_offset), n_total
 
 
 def _merge_shard_candidates(d2, idx, num_shards, qpad, k, full=False):

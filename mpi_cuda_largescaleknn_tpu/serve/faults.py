@@ -32,8 +32,13 @@ Spec string grammar (env var / admin endpoint)::
     op        := 'latency' | 'error' | 'drop' | 'close_mid_body'
     kv        := key '=' value      # path=/route_knn p=0.5 n=3 after=10
                                     # code=503 delay_s=0.2 seed=7
+                                    # method=POST
 
-``path`` is a substring match against the request path ('' matches all).
+``path`` is a substring match against the request path ('' matches all);
+``method`` restricts a rule to one HTTP verb ('' matches all) — e.g.
+``drop:path=/route_knn,method=POST`` kills the serving path while
+``GET /healthz`` keeps answering, the probes-lie failure mode the routed
+fan-out's per-batch failure budget exists for (serve/frontend.py).
 """
 
 from __future__ import annotations
@@ -53,13 +58,14 @@ FAULTS_ENV = "KNN_FAULTS"
 class FaultSpec:
     """One injection rule + its deterministic firing state."""
 
-    def __init__(self, op: str, *, path: str = "", p: float = 1.0,
-                 n: int = -1, after: int = 0, code: int = 500,
-                 delay_s: float = 0.05, seed: int = 0):
+    def __init__(self, op: str, *, path: str = "", method: str = "",
+                 p: float = 1.0, n: int = -1, after: int = 0,
+                 code: int = 500, delay_s: float = 0.05, seed: int = 0):
         if op not in FAULT_OPS:
             raise ValueError(f"unknown fault op {op!r} (one of {FAULT_OPS})")
         self.op = op
         self.path = str(path)
+        self.method = str(method).upper()
         self.p = float(p)
         self.n = int(n)
         self.after = int(after)
@@ -72,7 +78,8 @@ class FaultSpec:
         self._rng = random.Random(self.seed)
 
     def config(self) -> dict:
-        return {"op": self.op, "path": self.path, "p": self.p, "n": self.n,
+        return {"op": self.op, "path": self.path, "method": self.method,
+                "p": self.p, "n": self.n,
                 "after": self.after, "code": self.code,
                 "delay_s": self.delay_s, "seed": self.seed,
                 "seen": self.seen, "fires": self.fires}
@@ -95,7 +102,7 @@ def parse_fault_specs(text: str) -> list[FaultSpec]:
                 continue
             key, _, val = kv.partition("=")
             key = key.strip()
-            if key == "path":
+            if key in ("path", "method"):
                 kwargs[key] = val.strip()
             elif key in ("n", "after", "code", "seed"):
                 kwargs[key] = int(val)
@@ -139,11 +146,16 @@ class FaultInjector:
         with self._lock:
             return bool(self._specs)
 
-    def decide(self, path: str) -> FaultSpec | None:
-        """First matching spec that fires for this request, else None."""
+    def decide(self, path: str, method: str = "") -> FaultSpec | None:
+        """First matching spec that fires for this request, else None.
+        ``method`` (the HTTP verb; '' in a spec matches all) is part of
+        the match, BEFORE the skip/budget counters — a method-filtered
+        rule only counts the requests it could fire on."""
         with self._lock:
             for spec in self._specs:
                 if spec.path and spec.path not in path:
+                    continue
+                if spec.method and spec.method != method.upper():
                     continue
                 spec.seen += 1
                 if spec.seen <= spec.after:

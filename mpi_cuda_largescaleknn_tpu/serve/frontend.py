@@ -78,6 +78,20 @@ then scales with hosts instead of trailing one host
 (``serve_smoke.py --routing-bench``); results stay bit-identical to the
 replicate-everything pod because slab sharding keeps ids ascending by
 host, making the pod's shard-major tie discipline THE canonical order.
+
+Replication (docs/SERVING.md "Replication & slab handoff"): routed hosts
+claiming the same row range are REPLICAS of one slab — byte-
+interchangeable by the replica fingerprint gate — and every routing
+decision above is per SLAB, with one healthy member picked per sub-batch
+by deterministic health-weighted spreading (serve/replica.py
+``ReplicaSet``). A single drained host is then simply routed around at
+full exactness; ``exact: false`` fires only when ALL replicas of an
+improving slab are down. The monitor's ``ReplicaManager`` closes the
+loop with slab HANDOFF: a warm ``--standby`` host adopts an under-
+replicated slab (``POST /adopt_slab`` — re-materialized from the source
+file or pulled from a surviving replica) and is bound into the replica
+set only after its fingerprint proves config+bounds+AOT parity against
+the pod table.
 """
 
 from __future__ import annotations
@@ -150,6 +164,7 @@ class HostSliceServer(ThreadingHTTPServer):
     def __init__(self, addr, engine, *, routing: str = "off",
                  seq_timeout_s: float | None = None,
                  faults: FaultInjector | None = None,
+                 standby_config: dict | None = None,
                  verbose: bool = False):
         if routing not in ("off", "bounds"):
             raise ValueError(f"routing must be 'off' or 'bounds', "
@@ -162,7 +177,19 @@ class HostSliceServer(ThreadingHTTPServer):
         #: deterministic fault injection (serve/faults.py): programmatic,
         #: or KNN_FAULTS at start, or POST /faults at runtime
         self.faults = faults if faults is not None else FaultInjector.from_env()
-        if routing == "bounds":
+        #: warm-standby mode (slab handoff, serve/replica.py): the server
+        #: starts with NO engine and materializes one on POST /adopt_slab
+        #: from the engine-construction knobs recorded here (path, k,
+        #: shards, bucket geometry — serve_main --standby fills it)
+        self.standby_config = dict(standby_config) if standby_config else None
+        if self.standby_config is not None:
+            if routing != "bounds":
+                raise ValueError("standby hosts serve the routed tier — "
+                                 "launch with --routing bounds")
+            if engine is not None:
+                raise ValueError("a standby starts empty; its engine is "
+                                 "materialized by POST /adopt_slab")
+        elif routing == "bounds":
             if getattr(engine, "emit", "final") != "candidates":
                 raise ValueError(
                     "routed host serving needs an engine built with "
@@ -182,6 +209,15 @@ class HostSliceServer(ThreadingHTTPServer):
         self.metrics = ServingMetrics()
         self._seq_cond = threading.Condition()
         self.next_seq: guarded_by("_seq_cond") = 0
+        self._adopt_lock = threading.Lock()
+        # adoption lifecycle, written by the adopt handler + its
+        # background thread and read by /healthz scrapes (the replica
+        # manager polls it) — all access under _adopt_lock
+        self.adopt_state: guarded_by("_adopt_lock") = (
+            "standby" if self.standby_config is not None else None)
+        self.adopt_error: guarded_by("_adopt_lock") = None
+        self.adopt_slab: guarded_by("_adopt_lock") = None
+        self.adopt_seconds: guarded_by("_adopt_lock") = None
         super().__init__(addr, _HostHandler)
 
     def serve_forever(self, poll_interval=0.5):
@@ -236,6 +272,88 @@ class HostSliceServer(ThreadingHTTPServer):
         handle = self.engine.dispatch(queries)
         return self.engine.complete_candidates(handle)
 
+    # ------------------------------------------------------------- handoff
+
+    def adopt_snapshot(self) -> dict:
+        """Locked view of the adoption lifecycle (None state = not a
+        standby) — what /healthz reports and the replica manager polls."""
+        with self._adopt_lock:
+            return {"state": self.adopt_state, "slab": self.adopt_slab,
+                    "error": self.adopt_error,
+                    "seconds": self.adopt_seconds}
+
+    def start_adoption(self, req: dict, host_id: int,
+                       num_hosts: int) -> bool:
+        """Begin adopting slab ``host_id`` of ``num_hosts`` on a
+        background thread (engine builds take seconds — the HTTP handler
+        answers 202 immediately and the manager polls /healthz). False
+        when an adoption is already running or done (409 upstream);
+        ``failed`` may retry."""
+        with self._adopt_lock:
+            if self.adopt_state not in ("standby", "failed"):
+                return False
+            self.adopt_state = "adopting"
+            self.adopt_slab = int(host_id)
+            self.adopt_error = None
+        threading.Thread(target=self._run_adoption,
+                         args=(dict(req), int(host_id), int(num_hosts)),
+                         daemon=True, name="knn-adopt").start()
+        return True
+
+    def _run_adoption(self, req: dict, host_id: int, num_hosts: int):
+        """Materialize + warm the adopted slab, then flip ready. The
+        engine is assigned BEFORE ``ready`` so a handler that sees
+        ready=True always sees the engine; any failure parks the server
+        back in ``failed`` with the reason on /healthz (the manager's
+        fingerprint gate then never sees a half-built host)."""
+        from mpi_cuda_largescaleknn_tpu.serve.engine import (
+            materialize_slab_engine,
+        )
+        from mpi_cuda_largescaleknn_tpu.serve.replica import pull_slab_rows
+
+        t0 = time.perf_counter()
+        try:
+            cfg = dict(self.standby_config)
+            points = id_offset = None
+            if req.get("source_url"):
+                points, id_offset = pull_slab_rows(req["source_url"])
+            eng, id_offset, _n_total = materialize_slab_engine(
+                cfg.get("path"), host_id, num_hosts,
+                k=cfg["k"], shards=cfg.get("shards"),
+                engine=cfg.get("engine", "auto"),
+                merge=cfg.get("merge", "auto"),
+                bucket_size=cfg.get("bucket_size", 0),
+                max_radius=cfg.get("max_radius", float("inf")),
+                max_batch=cfg.get("max_batch", 1024),
+                min_batch=cfg.get("min_batch", 8),
+                query_buckets=cfg.get("query_buckets", 0),
+                score_dtype=cfg.get("score_dtype", "f32"),
+                points=points, id_offset=id_offset, warmup=True)
+            # the adopt request carries the pod table's slab identity:
+            # a file/num_hosts mismatch must fail HERE, loudly, not leak
+            # wrong rows to the (fingerprint-gated) bind downstream
+            want_off = req.get("row_offset")
+            if want_off is not None and int(want_off) != id_offset:
+                raise ValueError(
+                    f"adopted slab starts at row {id_offset}, the pod "
+                    f"table expects {want_off} — input file or num_hosts "
+                    "disagrees with the pod's split")
+            want_n = req.get("n_points")
+            if want_n is not None and int(want_n) != eng.n_points:
+                raise ValueError(
+                    f"adopted slab holds {eng.n_points} rows, the pod "
+                    f"table expects {want_n}")
+            eng.set_launch_workers(2)
+            self.engine = eng
+            self.ready = True
+            with self._adopt_lock:
+                self.adopt_state = "adopted"
+                self.adopt_seconds = round(time.perf_counter() - t0, 3)
+        except Exception as e:  # noqa: BLE001 - surfaced on /healthz
+            with self._adopt_lock:
+                self.adopt_state = "failed"
+                self.adopt_error = f"{type(e).__name__}: {e}"
+
 
 class _HostHandler(JsonHttpHandler):
     def do_GET(self):
@@ -247,6 +365,32 @@ class _HostHandler(JsonHttpHandler):
             return
         if self._apply_fault(path):
             return
+        if srv.engine is None:
+            # warm standby (slab handoff): no slab adopted yet — /healthz
+            # reports the adoption lifecycle so the replica manager can
+            # poll it; everything else answers 503 until adoption lands
+            snap = srv.adopt_snapshot()
+            status = {"standby": "standby", "adopting": "adopting",
+                      "failed": "adopt-failed"}.get(snap["state"],
+                                                    "standby")
+            if path == "/healthz":
+                body = {"status": status, "role": "standby",
+                        "routing": srv.routing, "adopt": snap}
+                if snap["error"]:
+                    body["adopt_error"] = snap["error"]
+                self._send_json(503, body)
+            elif path == "/stats":
+                self._send_json(200, {"routing": srv.routing,
+                                      "standby": True, "adopt": snap,
+                                      "server": srv.metrics.snapshot()})
+            elif path == "/metrics":
+                self._send(200, "# TYPE knn_ready gauge\nknn_ready 0\n"
+                           .encode(), "text/plain; version=0.0.4")
+            else:
+                self._send_json(503, {"error": "standby host: no slab "
+                                               "adopted yet"},
+                                extra=[("Retry-After", "1")])
+            return
         if path == "/healthz":
             body = {"status": "ok" if srv.ready else "warming",
                     "role": ("host-routed" if srv.routing == "bounds"
@@ -254,12 +398,34 @@ class _HostHandler(JsonHttpHandler):
                     "routing": srv.routing,
                     "process_index": srv.engine.process_index,
                     "next_seq": srv.next_seq_snapshot()}
+            adopt = srv.adopt_snapshot()
+            if adopt["state"] is not None:
+                body["adopt"] = adopt
             self._send_json(200 if srv.ready else 503, body)
         elif path == "/stats":
             self._send_json(200, {"engine": srv.engine.stats(),
                                   "routing": srv.routing,
                                   "next_seq": srv.next_seq_snapshot(),
                                   "server": srv.metrics.snapshot()})
+        elif path == "/slab_rows":
+            # slab handoff's pull path: a standby adopting this host's
+            # slab fetches the host-side rows instead of re-reading the
+            # source file (serve/replica.py pull_slab_rows)
+            pts = getattr(srv.engine, "host_points", None)
+            if pts is None:
+                self._send_json(404, {
+                    "error": "no host-side slab rows on this server "
+                             "(routed slab hosts only)"})
+                return
+            # zero-copy: the slab is 1/H of the index and the pull lands
+            # exactly while this host absorbs the dead replica's load —
+            # a .tobytes() here would transiently double the slab's RAM
+            body = memoryview(np.ascontiguousarray(pts, "<f4")).cast("B")
+            self._send(200, body, "application/octet-stream",
+                       extra=[("X-Knn-Rows", str(len(pts))),
+                              ("X-Knn-Dim", str(srv.engine.dim)),
+                              ("X-Knn-Row-Offset",
+                               str(srv.engine.id_offset))])
         elif path == "/metrics":
             e = srv.engine.stats()
             lines = []
@@ -304,6 +470,41 @@ class _HostHandler(JsonHttpHandler):
             self._send_json(200, {"specs": srv.faults.config()})
             return
         if self._apply_fault(parsed.path):
+            return
+        if parsed.path == "/adopt_slab":
+            # slab handoff (serve/replica.py): direct a warm standby to
+            # materialize + warm one slab. 202 = adoption started; the
+            # caller polls /healthz and fingerprint-gates before binding
+            if srv.standby_config is None:
+                self._send_json(409, {
+                    "error": "not a standby host — adopt_slab only "
+                             "applies to --standby processes"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                obj = json.loads(self.rfile.read(length).decode() or "{}")
+                host_id = int(obj["host_id"])
+                num_hosts = int(obj.get(
+                    "num_hosts", srv.standby_config.get("num_hosts", 1)))
+                if not (0 <= host_id < num_hosts):
+                    raise ValueError(f"host_id {host_id} outside "
+                                     f"[0, {num_hosts})")
+            except (KeyError, ValueError, json.JSONDecodeError) as e:
+                self._send_json(400, {"error": f"bad adopt request: {e}"})
+                return
+            if not srv.start_adoption(obj, host_id, num_hosts):
+                self._send_json(409, {
+                    "error": "adoption already in progress or done",
+                    "adopt": srv.adopt_snapshot()})
+                return
+            self._send_json(202, {"status": "adopting",
+                                  "host_id": host_id,
+                                  "num_hosts": num_hosts})
+            return
+        if srv.engine is None:
+            self._send_json(503, {"error": "standby host: no slab "
+                                           "adopted yet"},
+                            extra=[("Retry-After", "1")])
             return
         want = "/route_knn" if srv.routing == "bounds" else "/shard_knn"
         if parsed.path != want:
@@ -423,6 +624,9 @@ class PodFanout:
                  health_config: dict | None = None):
         if not host_urls:
             raise ValueError("need at least one host URL")
+        #: retained so runtime-bound endpoints (slab handoff's
+        #: bind_replica) get the same health lifecycle knobs
+        self._health_cfg = health_config
         self.endpoints = [_HostEndpoint(u, health_config)
                           for u in host_urls]
         self.k = int(k)
@@ -765,15 +969,26 @@ class RoutedPodFanout(PodFanout):
                  timers: PhaseTimers | None = None, dim: int = 3,
                  retries: int = 2, retry_backoff_s: float = 0.05,
                  request_timeout_s: float | None = None,
-                 health_config: dict | None = None):
+                 health_config: dict | None = None,
+                 replica_groups: list[dict] | None = None,
+                 spread_seed: int = 0):
+        from mpi_cuda_largescaleknn_tpu.serve.replica import ReplicaSet
+
         super().__init__(host_urls, k=k, max_batch=max_batch,
                          timeout_s=timeout_s, timers=timers, dim=dim,
                          retries=retries, retry_backoff_s=retry_backoff_s,
                          request_timeout_s=request_timeout_s,
                          health_config=health_config)
-        if bounds.num_hosts != len(self.endpoints):
+        #: slab -> replica-endpoint-group table (serve/replica.py): every
+        #: routing decision is per SLAB; a healthy member is picked per
+        #: sub-batch. None = the trivial R=1 set (one slab per endpoint),
+        #: which reproduces the pre-replica behavior exactly.
+        self.replicas = ReplicaSet(self.endpoints, replica_groups,
+                                   seed=spread_seed)
+        if bounds.num_hosts != self.replicas.num_slabs:
             raise ValueError(f"bounds table covers {bounds.num_hosts} "
-                             f"hosts, fan-out has {len(self.endpoints)}")
+                             f"slabs, replica set has "
+                             f"{self.replicas.num_slabs}")
         self.bounds = bounds
         self.routing_mode = "bounds"
         self.cert_slack = routing_cert_slack(self.dim)
@@ -785,6 +1000,19 @@ class RoutedPodFanout(PodFanout):
         self.hosts_per_query: guarded_by("_lock") = Counter()
         for ep in self.endpoints:
             ep.routed_rows = 0
+
+    def bind_replica(self, slab: int, url: str) -> _HostEndpoint:
+        """Runtime re-bind of a slab's endpoint set: add a NEW endpoint
+        (a handoff-validated adopted standby) as a replica of ``slab``.
+        Only the replica manager calls this, AFTER the fingerprint gate —
+        an unproven slab must never enter the routing tables. The
+        endpoint list only ever grows (append is atomic under the GIL;
+        dispatch threads iterate by index)."""
+        ep = _HostEndpoint(url, self._health_cfg)
+        ep.routed_rows = 0
+        self.endpoints.append(ep)
+        self.replicas.rebind(slab, len(self.endpoints) - 1)
+        return ep
 
     # ------------------------------------------------------------- transport
 
@@ -844,64 +1072,85 @@ class RoutedPodFanout(PodFanout):
                     ep.retries += 1
                 self._sleep(self.retry_backoff.delay(attempt, key=ep.url))
 
-    def _submit_wave(self, q: np.ndarray, rows_by_host) -> list:
-        """Post per-host sub-batches concurrently; returns
-        [(host_i, rows, future)] for the non-empty ones."""
+    def _submit_wave(self, q: np.ndarray, rows_by_slab,
+                     batch_failures: dict | None = None) -> list:
+        """Post per-slab sub-batches concurrently, each to one healthy
+        replica chosen by the spread policy (``ReplicaSet.pick`` — batch
+        failures first, so a replica that just failed this batch is
+        routed around immediately); returns ``[(slab, ep_index, rows,
+        future)]`` for the sub-batches actually submitted. A slab whose
+        every member is drained or over its per-batch budget submits
+        nothing — the caller leaves those rows unvisited and the
+        on-host-loss policy resolves them."""
         futs = []
-        for h, rows in rows_by_host:
+        for s, rows in rows_by_slab:
             if len(rows) == 0:
                 continue
+            ep_i = self.replicas.pick(s, penalties=batch_failures,
+                                      budget=self.retries)
+            if ep_i is None:
+                continue
             body = np.ascontiguousarray(q[rows], "<f4").tobytes()
-            futs.append((h, rows,
+            futs.append((s, ep_i, rows,
                          self._pool.submit(self._post_route,
-                                           self.endpoints[h], body,
+                                           self.endpoints[ep_i], body,
                                            len(rows))))
         return futs
 
     # ---------------------------------------------------------- query_fn API
 
     def dispatch(self, queries: np.ndarray):
-        """Wave 1: each query to its nearest-bounds AVAILABLE host, PLUS
-        every available host whose boxes contain it (non-blocking). A zero
-        lower bound can never be certified away (0 <= kth_dist2 always),
-        so an inside-the-box host would be escalated to unconditionally —
-        visiting it in wave 1 spends the same rows one round trip earlier,
-        which is most of the boundary traffic's latency. Drained hosts are
-        simply not routed to — whether the answers they would have touched
+        """Wave 1: each query to its nearest-bounds AVAILABLE slab (one
+        picked replica of it), PLUS every available slab whose boxes
+        contain it (non-blocking). A zero lower bound can never be
+        certified away (0 <= kth_dist2 always), so an inside-the-box slab
+        would be escalated to unconditionally — visiting it in wave 1
+        spends the same rows one round trip earlier, which is most of the
+        boundary traffic's latency. A slab is unavailable only when EVERY
+        replica is drained — a single drained host is simply routed
+        around; whether the answers a fully-down slab would have touched
         are 503d or served degraded is ``complete``'s caller's policy."""
         q = np.ascontiguousarray(np.asarray(queries, np.float32)
                                  .reshape(-1, self.dim))
         n = len(q)
+        num_slabs = self.replicas.num_slabs
         lb = self.bounds.lower_bounds(q)
-        visited = np.zeros((n, len(self.endpoints)), bool)
+        visited = np.zeros((n, num_slabs), bool)
         futs = []
         if n:
-            avail = ~self.drained_mask()
+            avail = self.replicas.slab_live_mask()
             lb_route = np.where(avail[None, :], lb, np.inf)
             first = np.argmin(lb_route, axis=1)
             reachable = np.isfinite(lb_route[np.arange(n), first])
-            visited |= (lb <= 0.0) & avail[None, :]
-            visited[np.nonzero(reachable)[0], first[reachable]] = True
-            waves = [(h, np.nonzero(visited[:, h])[0])
-                     for h in range(len(self.endpoints))]
+            want = (lb <= 0.0) & avail[None, :]
+            want[np.nonzero(reachable)[0], first[reachable]] = True
+            waves = [(s, np.nonzero(want[:, s])[0])
+                     for s in range(num_slabs)]
             futs = self._submit_wave(q, waves)
+            # only rows actually submitted count as visited: a slab whose
+            # last replica drained between the mask and the pick stays
+            # unvisited and resolves per policy
+            for s, _ep_i, rows, _f in futs:
+                visited[rows, s] = True
         return {"q": q, "n": n, "lb": lb, "visited": visited,
                 "futs": futs, "t0": time.perf_counter()}
 
     def complete(self, handle):
-        """Fold wave partials; escalate uncertified (query, host) pairs.
+        """Fold wave partials; escalate uncertified (query, slab) pairs.
 
-        Returns ``(dists, idx, exact)``. A host that fails all its retries
-        feeds the health state machine (eventually draining it) and its
-        sub-batch is put back on the uncertified list: while the host
-        stays available the next wave retries it, and once it drains the
-        loop routes around it. After certification converges, any (query,
-        drained-host) pair whose bound could still improve the query marks
-        that query ``exact=False`` — the fold of the surviving hosts'
-        partials is still well-defined (commutative), just possibly
-        missing that slab's candidates. Queries whose certified routing
-        set never touched a drained slab stay bit-identical to a healthy
-        pod."""
+        Returns ``(dists, idx, exact)``. A replica that fails all its
+        retries feeds the health state machine (eventually draining it)
+        and its sub-batch is put back on the uncertified list: the next
+        wave's pick prefers a DIFFERENT live replica of the same slab (a
+        single host loss costs one extra round trip, never exactness),
+        falling back to wave-level retry of the same host only when it is
+        the slab's sole member. After certification converges, any
+        (query, all-replicas-down slab) pair whose bound could still
+        improve the query marks that query ``exact=False`` — the fold of
+        the surviving slabs' partials is still well-defined
+        (commutative), just possibly missing that slab's candidates.
+        Queries whose certified routing set never touched a fully-down
+        slab stay bit-identical to a healthy pod."""
         n, k = handle["n"], self.k
         cur_d2 = np.full((n, k), np.inf, np.float32)
         cur_idx = np.full((n, k), -1, np.int32)
@@ -909,6 +1158,7 @@ class RoutedPodFanout(PodFanout):
             return (np.zeros(0, np.float32), cur_idx,
                     np.zeros(0, bool))
         q, visited = handle["q"], handle["visited"]
+        num_slabs = self.replicas.num_slabs
         # the dim-scaled slack makes the certification conservative
         # against the engines' f32 rounding (routing_cert_slack)
         lb_safe = handle["lb"] * (1.0 - self.cert_slack)
@@ -916,16 +1166,16 @@ class RoutedPodFanout(PodFanout):
         futs = handle["futs"]
         dts = []
         wave = 1
-        # per-BATCH failure budget per host: wave-level retries are capped
-        # independently of the global drain threshold, so a host that
-        # keeps answering /healthz (resetting its failure streak via the
-        # monitor) while failing /route_knn can never loop this batch
-        # forever — once over budget it is treated as unavailable for THIS
-        # batch and its queries resolve per the on-host-loss policy
-        batch_failures = np.zeros(len(self.endpoints), int)
+        # per-BATCH failure budget per ENDPOINT: wave-level retries are
+        # capped independently of the global drain threshold, so a host
+        # that keeps answering /healthz (resetting its failure streak via
+        # the monitor) while failing /route_knn can never loop this batch
+        # forever — once over budget it is unusable for THIS batch; a
+        # slab with no usable member resolves per the on-host-loss policy
+        batch_failures: dict[int, int] = {}
         while True:
-            for h, rows, fut in futs:
-                ep = self.endpoints[h]
+            for s, ep_i, rows, fut in futs:
+                ep = self.endpoints[ep_i]
                 try:
                     d2, idx, dt = fut.result()
                 except HostCallError as e:
@@ -933,13 +1183,13 @@ class RoutedPodFanout(PodFanout):
                         ep.errors += 1
                         ep.last_error = str(e)
                     ep.health.note_failure(str(e))
-                    batch_failures[h] += 1
-                    # un-visit the lost sub-batch: if the host is still
-                    # available the certification loop re-dispatches it
-                    # (wave-level retry); once drained or over its batch
-                    # budget, these pairs surface as uncertified ->
+                    batch_failures[ep_i] = batch_failures.get(ep_i, 0) + 1
+                    # un-visit the lost sub-batch: the certification loop
+                    # re-dispatches it to another replica (or retries the
+                    # sole member while it stays usable); once the whole
+                    # slab is out, these pairs surface as uncertified ->
                     # degraded/failed per policy
-                    visited[rows, h] = False
+                    visited[rows, s] = False
                     continue
                 with self._lock:
                     ep.ok += 1
@@ -950,8 +1200,8 @@ class RoutedPodFanout(PodFanout):
                 _fold_candidates(cur_d2, cur_idx, rows, d2, idx, k)
             r2 = cur_d2[:, k - 1].astype(np.float64)
             need = (~visited) & reachable & (lb_safe <= r2[:, None])
-            avail = (~self.drained_mask()
-                     & (batch_failures <= self.retries))
+            avail = self.replicas.slab_live_mask(
+                penalties=batch_failures, budget=self.retries)
             dispatchable = need & avail[None, :]
             if not dispatchable.any():
                 break
@@ -961,12 +1211,19 @@ class RoutedPodFanout(PodFanout):
                         dispatchable.any(axis=1).sum())
                 self.escalation_waves += 1
             wave += 1
-            waves = [(h, np.nonzero(dispatchable[:, h])[0])
-                     for h in range(len(self.endpoints))]
-            visited |= dispatchable
-            futs = self._submit_wave(q, waves)
-        # certification closed over the AVAILABLE hosts; whatever remains
-        # uncertified points at drained slabs — those queries are inexact
+            waves = [(s, np.nonzero(dispatchable[:, s])[0])
+                     for s in range(num_slabs)]
+            futs = self._submit_wave(q, waves, batch_failures)
+            if not futs:
+                # no sub-batch could be submitted (every needed slab lost
+                # its last usable replica between mask and pick): no
+                # progress is possible — resolve the remainder per policy
+                break
+            for s, _ep_i, rows, _f in futs:
+                visited[rows, s] = True
+        # certification closed over the AVAILABLE slabs; whatever remains
+        # uncertified points at fully-down slabs — those queries are
+        # inexact
         uncertified = (~visited) & reachable & (lb_safe <= r2[:, None])
         exact = ~uncertified.any(axis=1)
         with self._lock:
@@ -988,6 +1245,7 @@ class RoutedPodFanout(PodFanout):
 
     def stats(self) -> dict:
         s = super().stats()
+        replicas = self.replicas.stats()
         with self._lock:
             total_q = sum(self.hosts_per_query.values())
             total_h = sum(c * v for c, v in self.hosts_per_query.items())
@@ -1003,6 +1261,9 @@ class RoutedPodFanout(PodFanout):
                                     sorted(self.hosts_per_query.items())},
                 "hosts_per_query_mean": round(total_h / total_q, 4)
                 if total_q else None,
+                # replication surface: per-slab member/live table + the
+                # spread counters (how picks distributed across replicas)
+                "replicas": replicas,
             }
         return s
 
@@ -1227,6 +1488,36 @@ class _FrontendHandler(JsonHttpHandler):
             lines += [f'knn_hosts_per_query_bucket{{le="+Inf"}} {total}',
                       f"knn_hosts_per_query_sum {hsum}",
                       f"knn_hosts_per_query_count {total}"]
+            # replication surface: live replicas per slab (0 = the only
+            # state that can cost exactness), pick-spread per host, and
+            # the handoff counters from the monitor's replica manager
+            replicas = routing.get("replicas")
+            if replicas:
+                lines += ["# TYPE knn_replica_live gauge"] + [
+                    f'knn_replica_live{{slab="{p["slab"]}"}} {p["live"]}'
+                    for p in replicas["per_slab"]]
+                lines += ["# TYPE knn_replica_spread gauge"] + [
+                    f'knn_replica_spread{{host="{u}"}} {c}'
+                    for u, c in sorted(replicas["spread"].items())]
+                lines += ["# TYPE knn_replica_rebinds_total counter",
+                          f"knn_replica_rebinds_total "
+                          f"{replicas['rebinds']}"]
+            mon = srv.monitor
+            handoff = (mon.stats().get("handoff")
+                       if mon is not None else None)
+            if handoff:
+                lines += [
+                    "# TYPE knn_handoffs_total counter",
+                    f"knn_handoffs_total {handoff['handoffs']}",
+                    "# TYPE knn_handoff_rejections_total counter",
+                    f"knn_handoff_rejections_total "
+                    f"{handoff['handoff_rejections']}",
+                    "# TYPE knn_handoff_failures_total counter",
+                    f"knn_handoff_failures_total "
+                    f"{handoff['handoff_failures']}",
+                    "# TYPE knn_handoff_seconds_total counter",
+                    f"knn_handoff_seconds_total "
+                    f"{handoff['handoff_seconds_total']}"]
         lines += srv.metrics.latency.prometheus_lines(
             "knn_request_latency_seconds")
         for src, prom in (("fanout_batch_seconds", "knn_fanout_batch_seconds"),
@@ -1420,32 +1711,27 @@ def pod_config_from_hosts(host_urls: list[str],
                   "(dist2, id) ties — distances stay exact, but "
                   "equal-distance neighbor-id choices may differ from the "
                   "replicate-everything pod")
-        order = sorted(range(len(stats)),
-                       key=lambda i: stats[i].get("row_offset", 0))
-        offset = 0
-        bounds_hosts = []
-        for i in order:
-            e = stats[i]
-            if e.get("row_offset", 0) != offset:
-                raise ValueError(
-                    f"routed host slabs do not tile the index: host "
-                    f"{host_urls[i]} starts at row {e.get('row_offset')}, "
-                    f"expected {offset} — a gap or overlap would drop or "
-                    "double-count neighbors")
-            bounds_hosts.append({"row_offset": e["row_offset"],
-                                 "n_points": e["n_points"],
-                                 "shards": e["shard_bounds"]})
-            offset += e["n_points"]
+        # replica grouping (serve/replica.py): hosts claiming the same row
+        # range are replicas of one slab — replica-for-replica fingerprint
+        # equality and slab tiling over the GROUPS are validated there
+        from mpi_cuda_largescaleknn_tpu.serve.replica import (
+            group_routed_hosts,
+        )
+
+        grouped = group_routed_hosts(host_urls, stats, fingerprints)
         return {"routing": "bounds",
-                "host_urls": [host_urls[i] for i in order],
+                "host_urls": grouped["host_urls"],
                 "fingerprints": fingerprints,
+                "replica_groups": grouped["slabs"],
+                "slab_fingerprints": grouped["slab_fingerprints"],
                 "k": ref["k"], "dim": ref.get("dim", 3),
                 "max_batch": min(e["max_batch"] for e in stats),
                 # routed sub-batches start the moment a host is idle (no
                 # pod-wide program to queue behind), so the batcher's
                 # stall-aware flush floor drops to 1 row
                 "min_batch": 1,
-                "n_points": offset, "bounds_hosts": bounds_hosts}
+                "n_points": grouped["n_points"],
+                "bounds_hosts": grouped["bounds_hosts"]}
     ref = stats[0]
     covered: set[int] = set()
     for url, e in zip(host_urls, stats):
@@ -1492,6 +1778,8 @@ def build_frontend(host_urls: list[str], *, host: str = "127.0.0.1",
                    probe_interval_s: float = 5.0, fail_threshold: int = 3,
                    health_config: dict | None = None,
                    start_monitor: bool = True,
+                   standbys: list[str] | None = None,
+                   handoff_floor: int = 1,
                    verbose: bool = False) -> FrontendServer:
     """Validate the pod and construct (but do not start) a FrontendServer;
     ``port=0`` picks a free port (``server.server_address[1]``).
@@ -1500,7 +1788,15 @@ def build_frontend(host_urls: list[str], *, host: str = "127.0.0.1",
     ``on_host_loss`` picks the drained-slab policy (fail = 503 affected
     queries, degrade = serve them flagged ``exact: false``); the health
     monitor starts supervising immediately unless ``start_monitor=False``
-    (tests drive ``server.monitor.check_once()`` by hand instead)."""
+    (tests drive ``server.monitor.check_once()`` by hand instead).
+    Routed pods: hosts claiming the same row range are REPLICAS of one
+    slab (exactness degrades only when all of a slab's replicas are
+    down); ``standbys`` lists warm ``--standby`` hosts the monitor's
+    replica manager directs to adopt a slab whose live-replica count
+    falls below ``handoff_floor`` (docs/SERVING.md "Replication & slab
+    handoff")."""
+    from mpi_cuda_largescaleknn_tpu.serve.replica import ReplicaManager
+
     cfg = pod_config_from_hosts(host_urls, routing=routing)
     hc = dict(fail_threshold=fail_threshold,
               probe_interval_s=probe_interval_s)
@@ -1511,8 +1807,13 @@ def build_frontend(host_urls: list[str], *, host: str = "127.0.0.1",
             cfg["host_urls"], k=cfg["k"], max_batch=cfg["max_batch"],
             bounds=table, timeout_s=timeout_s, dim=cfg["dim"],
             retries=retries, retry_backoff_s=retry_backoff_s,
-            request_timeout_s=request_timeout_s, health_config=hc)
+            request_timeout_s=request_timeout_s, health_config=hc,
+            replica_groups=cfg["replica_groups"])
     else:
+        if standbys:
+            raise ValueError("standby hosts (slab handoff) apply to "
+                             "routed pods only — a replicate-mode pod is "
+                             "one SPMD machine")
         fanout = PodFanout(cfg["host_urls"], k=cfg["k"],
                            max_batch=cfg["max_batch"],
                            timeout_s=timeout_s, dim=cfg["dim"],
@@ -1528,6 +1829,15 @@ def build_frontend(host_urls: list[str], *, host: str = "127.0.0.1",
     server.monitor = HealthMonitor(fanout,
                                    fingerprints=cfg["fingerprints"],
                                    mode=cfg["routing"])
+    if cfg["routing"] == "bounds":
+        # the handoff brain rides the monitor's check_once cadence; a
+        # bound standby is registered in the monitor's fingerprint table
+        # so its own later drain/rejoin cycles get the same gate
+        server.monitor.replica_manager = ReplicaManager(
+            fanout, slabs=cfg["replica_groups"],
+            slab_fingerprints=cfg["slab_fingerprints"],
+            standbys=standbys or [], handoff_floor=handoff_floor,
+            fingerprint_registry=server.monitor.fingerprints)
     if start_monitor:
         server.monitor.start()
     return server
@@ -1566,6 +1876,16 @@ FRONTEND_FLAGS = """
                     (default 5; drained hosts re-probe on capped
                     exponential backoff + jitter)
   --fail-threshold N  consecutive failures that drain a host (default 3)
+  --standbys U1,U2,...  warm standby hosts (serve_main --standby; routed
+                    pods only): when a slab's live-replica count falls
+                    below --handoff-floor the monitor directs one to
+                    ADOPT the slab (POST /adopt_slab), fingerprint-gated
+                    before it serves (docs/SERVING.md "Replication &
+                    slab handoff")
+  --handoff-floor N live replicas per slab below which a handoff starts
+                    (default 1 = hand off only when a slab is fully
+                    down; R with --handoff-floor R keeps full replication
+                    through any single loss)
   --verbose         log each HTTP request to stderr
 """
 
@@ -1581,6 +1901,7 @@ def main(argv: list[str] | None = None) -> int:
            "on_host_loss": "fail", "retries": 2,
            "retry_backoff_ms": 50.0, "request_timeout_ms": 0.0,
            "probe_interval_s": 5.0, "fail_threshold": 3,
+           "standbys": "", "handoff_floor": 1,
            "verbose": False}
     i = 0
     try:
@@ -1616,6 +1937,10 @@ def main(argv: list[str] | None = None) -> int:
                 i += 1; opt["probe_interval_s"] = float(args[i])
             elif a == "--fail-threshold":
                 i += 1; opt["fail_threshold"] = int(args[i])
+            elif a == "--standbys":
+                i += 1; opt["standbys"] = args[i]
+            elif a == "--handoff-floor":
+                i += 1; opt["handoff_floor"] = int(args[i])
             elif a == "--verbose":
                 opt["verbose"] = True
             else:
@@ -1643,7 +1968,9 @@ def main(argv: list[str] | None = None) -> int:
         request_timeout_s=(opt["request_timeout_ms"] / 1e3
                            if opt["request_timeout_ms"] > 0 else None),
         probe_interval_s=opt["probe_interval_s"],
-        fail_threshold=opt["fail_threshold"], verbose=opt["verbose"])
+        fail_threshold=opt["fail_threshold"],
+        standbys=[s for s in opt["standbys"].split(",") if s],
+        handoff_floor=opt["handoff_floor"], verbose=opt["verbose"])
     server.ready = True
     h, p = server.server_address[:2]
     mode = getattr(server.fanout, "routing_mode", "off")

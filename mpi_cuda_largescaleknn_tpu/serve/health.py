@@ -238,11 +238,23 @@ class HostHealth:
     def snapshot(self) -> dict:
         with self._lock:
             now = self._clock()
+            # the NEXT drained-probe delay (jitter excluded — the per-host
+            # jitter key is the fan-out's url, which this state machine
+            # does not know): 0.0 while healthy/suspect. A successful
+            # rejoin resets probe_attempt, so a later flap reads the BASE
+            # interval here again, never the cap (tests/test_failover.py
+            # pins that reset).
+            backoff_now = (
+                round(min(self.backoff.cap_s,
+                          self.backoff.base_s
+                          * self.backoff.factor ** self.probe_attempt), 3)
+                if self.state in ("drained", "rejoining") else 0.0)
             return {
                 "state": self.state,
                 "state_code": STATE_CODE[self.state],
                 "consecutive_failures": self.consecutive_failures,
                 "fail_threshold": self.fail_threshold,
+                "backoff_current_s": backoff_now,
                 "last_error": self.last_error,
                 "last_probe_age_s": (round(now - self.last_probe_at, 3)
                                      if self.last_probe_at is not None
@@ -296,6 +308,11 @@ class HealthMonitor:
             lambda url: _http_stats(url, self.probe_timeout_s))
         self._clock = clock
         self.poll_s = float(poll_s)
+        #: slab-handoff supervisor (serve/replica.py ReplicaManager),
+        #: attached by build_frontend on routed pods BEFORE start();
+        #: driven from check_once so handoffs ride the same cadence (and
+        #: the same fake-now test harness) as drain/rejoin
+        self.replica_manager = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
@@ -380,6 +397,12 @@ class HealthMonitor:
             h.schedule_next_probe(key=ep.url, now=now)
         if self.mode == "off":
             self._try_pod_reset(probe_ok)
+        rm = self.replica_manager
+        if rm is not None:
+            try:
+                rm.check_once(now)
+            except Exception as e:  # noqa: BLE001 - supervisor must survive
+                self._event(f"handoff error: {type(e).__name__}: {e}")
 
     def _fanout_broken(self) -> str | None:
         """The fan-out's broken marker through its LOCKED accessor —
@@ -460,9 +483,12 @@ class HealthMonitor:
         self._event(f"pod stream reset to seq {seqs[0]}")
 
     def stats(self) -> dict:
+        rm = self.replica_manager
+        handoff = rm.stats() if rm is not None else None
         with self._lock:
             return {"probes": self.probes, "rejoins": self.rejoins,
                     "rejoin_rejections": self.rejoin_rejections,
                     "stream_resets": self.stream_resets,
                     "running": self.running,
+                    "handoff": handoff,
                     "events": list(self.events[-10:])}

@@ -183,7 +183,8 @@ class JsonHttpHandler(BaseHTTPRequestHandler):
         inj = getattr(self.server, "faults", None)
         if inj is None or not inj.active():
             return False
-        return apply_http_fault(self, inj.decide(path))
+        return apply_http_fault(
+            self, inj.decide(path, getattr(self, "command", "") or ""))
 
 
 class _Handler(JsonHttpHandler):

@@ -531,6 +531,7 @@ class TestRepoGate:
             "serve/frontend.py": {"PodFanout", "RoutedPodFanout",
                                   "HostSliceServer"},
             "serve/health.py": {"HostHealth", "HealthMonitor"},
+            "serve/replica.py": {"ReplicaSet", "ReplicaManager"},
             "serve/server.py": {"ServingMetrics"},
         }
         for rel, expected in want.items():

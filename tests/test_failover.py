@@ -123,6 +123,26 @@ class TestFaultInjector:
         assert seq(9) != seq(10)      # and actually seed-driven
         assert 4 < sum(seq(9)) < 28   # a real coin, not constant
 
+    def test_method_filter_restricts_the_match(self):
+        """``method=POST`` kills the serving path while GET probes keep
+        answering — the probes-lie failure mode the fan-out's per-batch
+        budget covers; the filter is part of the MATCH, so the skip/fire
+        counters only see requests the rule could hit."""
+        from mpi_cuda_largescaleknn_tpu.serve.faults import (
+            FaultInjector,
+            parse_fault_specs,
+        )
+
+        inj = FaultInjector(parse_fault_specs(
+            "drop:path=/route_knn,method=POST"))
+        assert inj.decide("/route_knn", "POST") is not None
+        assert inj.decide("/route_knn", "GET") is None
+        assert inj.decide("/healthz", "POST") is None
+        # an unfiltered rule still matches any verb (back-compat)
+        inj.set_specs("drop:")
+        assert inj.decide("/x", "GET") is not None
+        assert inj.decide("/x") is not None
+
     def test_unknown_op_and_key_raise(self):
         from mpi_cuda_largescaleknn_tpu.serve.faults import parse_fault_specs
 
@@ -189,6 +209,30 @@ class TestHostHealth:
             delays.append(nxt - now)
             now = nxt
         assert delays == [1.0, 2.0, 4.0, 4.0]
+
+    def test_backoff_resets_after_rejoin_and_is_exposed(self):
+        """A successful rejoin must reset the drained-probe backoff: a
+        LATER flap restarts from the base interval, never the cap — and
+        ``backoff_current_s`` surfaces the live value per host (the
+        frontend /stats pod.health block carries the snapshot)."""
+        t = {"now": 0.0}
+        h = self._health(lambda: t["now"], fail_threshold=1,
+                         probe_interval_s=5.0, backoff_base_s=1.0,
+                         backoff_cap_s=8.0, jitter=0.0)
+        assert h.snapshot()["backoff_current_s"] == 0.0  # healthy: none
+        h.note_failure("down")
+        now = 0.0
+        for _ in range(3):  # ride the exponential to the cap
+            now = h.schedule_next_probe(now=now)
+        assert h.snapshot()["backoff_current_s"] == 8.0  # at the cap
+        h.mark_rejoining()
+        h.mark_rejoined()
+        assert h.snapshot()["backoff_current_s"] == 0.0  # reset with state
+        # a later flap restarts the schedule from BASE, not the cap
+        h.note_failure("down again")
+        assert h.snapshot()["backoff_current_s"] == 1.0
+        nxt = h.schedule_next_probe(now=100.0)
+        assert nxt - 100.0 == 1.0
 
 
 class _FakeFanout:

@@ -43,9 +43,15 @@ if [ "${SERVE_BENCH:-1}" != "0" ] && [ "$rc" -ne 124 ]; then
   # routed host killed mid-load via a deterministic fault-injected
   # outage — gated on availability under single-host loss (degrade mode
   # keeps answering, flagged exact:false) AND post-rejoin bitwise parity
+  # --replica-bench adds the replication/handoff section
+  # (replica_compare): a rolling single-host kill across an R=2 routed
+  # pod with a warm standby — gated on ZERO exact:false responses,
+  # availability >= 0.999, and the post-handoff probe being bitwise
+  # identical to the never-failed answers (the adopted slab proves
+  # itself); q/s at R=2 vs R=1 is the trajectory number
   timeout -k 10 2400 python tools/serve_smoke.py --duration 2 --trials 3 \
       --locality-bench --multihost-bench --kernel-bench --routing-bench \
-      --chaos-bench \
+      --chaos-bench --replica-bench \
       --out BENCH_serve.json >/dev/null || { brc=$?; [ "$rc" -eq 0 ] && rc=$brc; }
 fi
 # the lskcheck gate blocks even when the tests pass (and never masks a

@@ -190,7 +190,8 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
              scale: float = 1.0, server_stats: bool = False,
              binary: bool = False, workload: str = "uniform",
              blobs: int = 16, blob_sigma: float = 0.02,
-             hosts: list[str] | None = None) -> dict:
+             hosts: list[str] | None = None,
+             retry_after_cap_s: float = 1.0) -> dict:
     """Drive the server; returns the JSON-able report (also the test API).
 
     ``qps > 0`` switches to open loop: the request schedule is fixed at
@@ -211,10 +212,17 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
     holds one persistent connection per endpoint and rotates requests
     across them (front-end-BYPASS — point it at independent replica
     servers, NOT at one pod's slice servers, whose /shard_knn protocol is
-    collective). The report then carries per-endpoint p50/p95/p99 next to
-    the aggregate, so pointing ``--url`` at the pod front end vs
-    ``--hosts`` at the same machines' standalone servers measures exactly
-    the fan-out's overhead.
+    collective). The report then carries per-endpoint p50/p95/p99 AND
+    per-endpoint availability / degraded_rate next to the aggregate —
+    under a rolling host kill the aggregate can look healthy while one
+    endpoint serves every degraded answer; the per-endpoint split is how
+    the replica bench reads which host actually absorbed the loss.
+
+    ``retry_after_cap_s`` caps how long a closed-loop worker honors a
+    server's Retry-After on 503/429 (default 1.0 s): a chaos/replica
+    bench must not park its workers past the measurement window, while a
+    patient production client can raise it to the server's real drain
+    horizon.
     """
     if workload not in ("uniform", "clustered"):
         raise ValueError(f"unknown workload '{workload}'")
@@ -231,7 +239,8 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
               "unavailable": 0, "http_error": 0,
               "net_error": 0, "rows_ok": 0, "sched_skipped": 0}
     status_counts: dict[str, int] = {}
-    ep_counts = {u: {"requests": 0, "ok": 0, "errors": 0}
+    ep_counts = {u: {"requests": 0, "ok": 0, "errors": 0, "degraded": 0,
+                     "rejected": 0}
                  for u in endpoints}
     stop_at = time.monotonic() + duration_s
 
@@ -248,6 +257,7 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
                 ep_counts[endpoint]["ok"] += 1
                 if degraded:
                     counts["degraded"] += 1
+                    ep_counts[endpoint]["degraded"] += 1
             elif status == 429:
                 counts["overload"] += 1
             elif status == 503:
@@ -256,6 +266,8 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
                 counts["deadline"] += 1
             else:
                 counts["http_error"] += 1
+            if status != 200:
+                ep_counts[endpoint]["rejected"] += 1
 
     def one_request(pick_client, rng: np.random.Generator):
         """Fire one request; returns a Retry-After backoff (seconds) the
@@ -274,9 +286,10 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
             account(endpoint, status, time.perf_counter() - t0,
                     batch if status == 200 else 0, degraded)
             if status in (429, 503) and retry_after:
-                # honor the server's backpressure (cap it: a chaos-bench
-                # outage must not park workers past the measurement)
-                return min(retry_after, 1.0)
+                # honor the server's backpressure, capped by the
+                # --retry-after-cap knob (an outage must not park workers
+                # past the measurement window)
+                return min(retry_after, retry_after_cap_s)
         except Exception:  # noqa: BLE001 - connection refused/reset, timeout
             with lock:
                 counts["net_error"] += 1
@@ -361,9 +374,19 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
         per_endpoint = {}
         for u in endpoints:
             rep = ep_hists[u].report()
+            c = ep_counts[u]
             per_endpoint[u] = {
-                **ep_counts[u],
-                "qps": round(ep_counts[u]["requests"] / elapsed, 2),
+                **c,
+                "qps": round(c["requests"] / elapsed, 2),
+                # per-endpoint availability/degraded split: under a
+                # rolling kill the aggregate hides WHICH endpoint
+                # absorbed the loss — this is how the replica bench
+                # reads it (requests includes net errors, like the
+                # aggregate's attempted denominator)
+                "availability": (round(c["ok"] / c["requests"], 4)
+                                 if c["requests"] else None),
+                "degraded_rate": (round(c["degraded"] / c["ok"], 4)
+                                  if c["ok"] else None),
                 "p50_ms": _pct_ms(rep, "p50"),
                 "p95_ms": _pct_ms(rep, "p95"),
                 "p99_ms": _pct_ms(rep, "p99"),
@@ -436,6 +459,10 @@ def main(argv=None) -> int:
     ap.add_argument("--blob-sigma", type=float, default=0.02,
                     help="clustered: per-axis blob sigma as a fraction "
                          "of --scale")
+    ap.add_argument("--retry-after-cap", type=float, default=1.0,
+                    help="max seconds a closed-loop worker honors a "
+                         "Retry-After on 503/429 (default 1.0; raise for "
+                         "patient-client drills)")
     ap.add_argument("--server-stats", action="store_true",
                     help="embed a post-run /stats pipeline-occupancy scrape")
     ap.add_argument("--out", default=None, help="write JSON report here")
@@ -447,7 +474,8 @@ def main(argv=None) -> int:
                       timeout_s=a.timeout, seed=a.seed, scale=a.scale,
                       server_stats=a.server_stats, binary=a.binary,
                       workload=a.workload, blobs=a.blobs,
-                      blob_sigma=a.blob_sigma, hosts=hosts)
+                      blob_sigma=a.blob_sigma, hosts=hosts,
+                      retry_after_cap_s=a.retry_after_cap)
     text = json.dumps(report, indent=2)
     print(text)
     if a.out:

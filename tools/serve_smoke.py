@@ -12,7 +12,11 @@ deterministic fetched-bytes-per-pod ratio as the headline
 routing comparison — the same 2-host pod at ``--routing bounds`` vs
 ``--routing off`` on clustered and uniform workloads, gated on the probe
 batch being BITWISE identical between the two (tie ids included) and
-oracle-exact (``routing_compare``; tools/ci_tier1.sh passes all flags).
+oracle-exact (``routing_compare``), plus (``--replica-bench``) the
+replication/handoff drill — a rolling single-host kill across an R=2
+routed pod with a warm standby, gated on ZERO ``exact: false``
+responses, availability >= 0.999, and post-handoff bitwise probe parity
+(``replica_compare``; tools/ci_tier1.sh passes all flags).
 
 Boots the full serving stack in-process on a CPU fixture (default: one
 virtual device, single-threaded Eigen, tiled engine — one core per
@@ -918,6 +922,207 @@ def run_chaos_bench(*, n_points=8192, k=16, hosts=2, duration_s=2.0,
     return out
 
 
+def run_replica_bench(*, n_points=6144, k=8, slabs=2, replicas=2,
+                      duration_s=2.0, concurrency=8, batch=8,
+                      max_batch=64, max_delay_s=0.008, seed=0) -> dict:
+    """Replica bench: a rolling single-host kill across an R=2 routed pod
+    with a warm standby, gating on ZERO ``exact: false`` responses,
+    availability >= 0.999, and the post-handoff probe being BITWISE
+    identical to the pre-kill answers (``replica_compare``).
+
+    Topology: ``slabs`` x ``replicas`` in-process routed hosts (replicas
+    of a slab share one engine — byte-interchangeable by contract, so
+    the ADOPTED standby, which re-materializes the slab itself, is the
+    real parity subject) + the real front end at ``--on-host-loss
+    degrade`` with ``handoff_floor=replicas`` (any single loss starts a
+    handoff) and a fast health monitor. The roll: kill slab 0's second
+    replica mid-load (loadgen must see zero degraded answers — the
+    sibling absorbs the slab), wait for the standby to adopt + bind,
+    probe bitwise parity, then kill slab 0's FIRST replica too — the
+    slab is now served exclusively by the adopted standby, and the final
+    probe must still be bitwise-equal to the never-failed answers. An
+    R=1 twin of the same engines measures what the replication costs
+    (``qps_ratio_r2_vs_r1``; trajectory data, not a gate). 1-device
+    meshes per slab engine, the chaos bench's co-location discipline.
+    """
+    _setup_cpu_fixture(1)
+    from mpi_cuda_largescaleknn_tpu.models.sharding import slab_bounds
+    from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+    from mpi_cuda_largescaleknn_tpu.serve.engine import ResidentKnnEngine
+    from mpi_cuda_largescaleknn_tpu.serve.frontend import (
+        HostSliceServer,
+        build_frontend,
+    )
+    from mpi_cuda_largescaleknn_tpu.utils.math import morton_argsort
+
+    rng = np.random.default_rng(seed)
+    points = rng.random((n_points, 3)).astype(np.float32)
+    points = points[morton_argsort(points, points.min(0), points.max(0))]
+    with tempfile.NamedTemporaryFile(suffix=".float3", delete=False) as f:
+        pts_path = f.name
+    points.tofile(pts_path)
+
+    def boot_host(eng, **kw):
+        srv = HostSliceServer(("127.0.0.1", 0), eng, routing="bounds",
+                              **kw)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        if eng is not None:
+            srv.ready = True
+        return srv
+
+    engines = []
+    for b, e in slab_bounds(n_points, slabs):
+        eng = ResidentKnnEngine(points[b:e], k, mesh=get_mesh(1),
+                                engine="tiled", bucket_size=64,
+                                max_batch=max_batch, min_batch=16,
+                                id_offset=b, emit="candidates")
+        eng.warmup()
+        engines.append(eng)
+    r2_servers = [boot_host(engines[s]) for s in range(slabs)
+                  for _ in range(replicas)]
+    r1_servers = [boot_host(engines[s]) for s in range(slabs)]
+    standby = boot_host(None, standby_config=dict(
+        path=pts_path, num_hosts=slabs, k=k, shards=1, engine="tiled",
+        bucket_size=64, max_batch=max_batch, min_batch=16))
+    urls_r2 = [f"http://127.0.0.1:{s.server_address[1]}"
+               for s in r2_servers]
+    urls_r1 = [f"http://127.0.0.1:{s.server_address[1]}"
+               for s in r1_servers]
+    sb_url = f"http://127.0.0.1:{standby.server_address[1]}"
+    hc = dict(fail_threshold=2, probe_interval_s=0.1,
+              backoff_base_s=0.05, backoff_cap_s=0.5)
+    fe2 = build_frontend(urls_r2, port=0, max_delay_s=max_delay_s,
+                         pipeline_depth=2, on_host_loss="degrade",
+                         retries=2, retry_backoff_s=0.01,
+                         request_timeout_s=30.0, standbys=[sb_url],
+                         handoff_floor=replicas, health_config=hc)
+    fe1 = build_frontend(urls_r1, port=0, max_delay_s=max_delay_s,
+                         pipeline_depth=2, on_host_loss="degrade",
+                         retries=2, retry_backoff_s=0.01,
+                         request_timeout_s=30.0, health_config=hc)
+    for fe in (fe1, fe2):
+        fe.ready = True
+        threading.Thread(target=fe.serve_forever, daemon=True).start()
+    base2 = f"http://127.0.0.1:{fe2.server_address[1]}"
+    base1 = f"http://127.0.0.1:{fe1.server_address[1]}"
+
+    prng = np.random.default_rng(seed + 1)
+    q_probe = prng.random((64, 3)).astype(np.float32)
+
+    def probe():
+        body = json.dumps({"queries": q_probe.tolist(),
+                           "neighbors": True}).encode()
+        req = urllib.request.Request(
+            base2 + "/knn", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            obj = json.loads(resp.read())
+        return (np.asarray(obj["dists"], np.float32),
+                np.asarray(obj["neighbors"], np.int32),
+                bool(obj.get("exact", True)))
+
+    def kill(url):
+        req = urllib.request.Request(
+            url + "/faults", data=json.dumps({"spec": "drop:"}).encode(),
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=30).read()
+
+    def fe2_stats():
+        with urllib.request.urlopen(base2 + "/stats", timeout=30) as r:
+            return json.loads(r.read())
+
+    def phase(base, trial):
+        rep = _run_loadgen(base, duration_s=duration_s,
+                           concurrency=concurrency, batch=batch,
+                           seed=seed + trial)
+        return {"qps": rep["qps"], "availability": rep["availability"],
+                "degraded": rep["degraded"],
+                "degraded_rate": rep["degraded_rate"],
+                "net_error": rep["net_error"],
+                "status_counts": rep["status_counts"],
+                "p99_ms": rep["p99_ms"]}
+
+    out = {
+        "kind": "serve_replica_bench", "slabs": slabs,
+        "replicas": replicas, "n_points": n_points, "k": k,
+        "duration_s": duration_s, "concurrency": concurrency,
+        "batch": batch, "handoff_floor": replicas,
+        "on_host_loss": "degrade", "availability_floor": 0.999,
+    }
+    try:
+        pre_d, pre_n, pre_exact = probe()
+        out["pre_probe_exact"] = pre_exact
+        out["healthy_r2"] = phase(base2, 0)
+        out["healthy_r1"] = phase(base1, 0)
+
+        # roll 1: kill slab 0's SECOND replica mid-pod — the sibling
+        # absorbs the slab, so loadgen must see zero degraded answers
+        kill(urls_r2[1])
+        t_kill = time.monotonic()
+        out["outage1"] = phase(base2, 1)
+        # the handoff: floor=replicas, so live 1 < 2 starts an adoption;
+        # wait for the standby to adopt + the monitor to bind it
+        deadline = time.monotonic() + 180.0
+        bound = False
+        while time.monotonic() < deadline:
+            st = fe2_stats()
+            ho = (st["pod"]["monitor"] or {}).get("handoff") or {}
+            if ho.get("handoffs", 0) >= 1:
+                bound = True
+                break
+            if ho.get("handoff_failures", 0) or ho.get(
+                    "handoff_rejections", 0):
+                break
+            time.sleep(0.1)
+        out["handoff_bound"] = bound
+        out["handoff_s"] = round(time.monotonic() - t_kill, 3)
+        st = fe2_stats()
+        out["handoff_stats"] = (st["pod"]["monitor"] or {}).get("handoff")
+        mid_d, mid_n, mid_exact = probe()
+        out["post_handoff_probe_exact"] = mid_exact
+        out["post_handoff_parity"] = bool(
+            mid_exact and np.array_equal(pre_d, mid_d)
+            and np.array_equal(pre_n, mid_n))
+
+        # roll 2: kill slab 0's FIRST replica too — the slab now rides
+        # the adopted standby alone; exactness and bytes must hold
+        kill(urls_r2[0])
+        out["outage2"] = phase(base2, 2)
+        post_d, post_n, post_exact = probe()
+        out["final_probe_exact"] = post_exact
+        out["final_parity"] = bool(
+            post_exact and np.array_equal(pre_d, post_d)
+            and np.array_equal(pre_n, post_n))
+
+        replica_stats = fe2_stats()["fanout"]["routing"]["replicas"]
+        out["slab_live_after_roll"] = [p["live"] for p in
+                                       replica_stats["per_slab"]]
+        out["replica_spread"] = replica_stats["spread"]
+        phases = [out["healthy_r2"], out["outage1"], out["outage2"]]
+        out["zero_inexact"] = bool(
+            pre_exact and mid_exact and post_exact
+            and all(p["degraded"] == 0 for p in phases))
+        avails = [p["availability"] for p in phases]
+        out["availability_min"] = (min(avails)
+                                   if all(a is not None for a in avails)
+                                   else None)
+        out["availability_ok"] = (
+            out["availability_min"] is not None
+            and out["availability_min"] >= out["availability_floor"])
+        out["bitwise_parity_after_handoff"] = bool(
+            out["post_handoff_parity"] and out["final_parity"])
+        if out["healthy_r1"]["qps"]:
+            out["qps_ratio_r2_vs_r1"] = round(
+                out["healthy_r2"]["qps"] / out["healthy_r1"]["qps"], 3)
+    finally:
+        fe2.close()
+        fe1.close()
+        for s in r2_servers + r1_servers + [standby]:
+            s.close()
+        os.unlink(pts_path)
+    return out
+
+
 def run_kernel_bench(*, dims=(3, 8, 64), n_points=8192, n_queries=1024,
                      k=16, bucket_size=128, reps=5, seed=0) -> dict:
     """Elementwise (VPU) vs MXU matmul-form traversal kernel at each D:
@@ -1068,6 +1273,16 @@ def main(argv=None) -> int:
                     help="internal: run ONLY the chaos bench in this "
                          "process (needs its own 2-device fixture) and "
                          "print its JSON")
+    ap.add_argument("--replica-bench", action="store_true",
+                    help="also run the replica bench (rolling single-host "
+                         "kill across an R=2 routed pod with a warm "
+                         "standby: zero exact:false, availability >= "
+                         "0.999, post-handoff bitwise probe parity) in a "
+                         "subprocess and embed replica_compare")
+    ap.add_argument("--replica-child", action="store_true",
+                    help="internal: run ONLY the replica bench in this "
+                         "process (1-device fixture, boots its own pod + "
+                         "standby) and print its JSON")
     ap.add_argument("--kernel-bench", action="store_true",
                     help="also run the distance-kernel bench (elementwise "
                          "VPU vs MXU matmul-form at D in {3, 8, 64}) in a "
@@ -1086,6 +1301,17 @@ def main(argv=None) -> int:
         print(json.dumps(report, indent=2))
         return 0 if (report.get("bitwise_parity_after_rejoin")
                      and report.get("availability_ok")) else 1
+
+    if a.replica_child:
+        report = run_replica_bench(
+            duration_s=a.duration, concurrency=a.concurrency,
+            batch=min(a.batch, 8), max_delay_s=a.max_delay_ms / 1e3,
+            seed=a.seed)
+        print(json.dumps(report, indent=2))
+        return 0 if (report.get("zero_inexact")
+                     and report.get("availability_ok")
+                     and report.get("bitwise_parity_after_handoff")) \
+            else 1
 
     if a.kernel_child:
         report = run_kernel_bench(n_points=a.points, k=a.k, seed=a.seed)
@@ -1304,6 +1530,39 @@ def main(argv=None) -> int:
                 detail = (raw.decode(errors="replace")
                           if isinstance(raw, bytes) else str(raw))[-1500:]
             report["chaos_compare"] = {
+                "error": f"{str(e)[:300]} :: {detail}"}
+    if a.replica_bench:
+        # same subprocess discipline: the replica child boots its own
+        # R=2 routed pod + warm standby. ALL THREE replica gates ride the
+        # exit code (the issue's acceptance bar): zero exact:false
+        # through the rolling kill, availability >= 0.999, and the
+        # post-handoff probe bitwise-equal to the pre-kill answers; the
+        # R2-vs-R1 q/s ratio is the trajectory number
+        try:
+            child = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--replica-child",
+                 "--duration", str(a.duration),
+                 "--concurrency", str(a.concurrency),
+                 "--batch", str(a.batch),
+                 "--max-delay-ms", str(a.max_delay_ms),
+                 "--seed", str(a.seed)],
+                capture_output=True, text=True, env=env,
+                timeout=600 + a.duration * 10)
+            rb = json.loads(child.stdout)
+            report["replica_compare"] = rb
+            if "error" not in rb:  # infra hiccups degrade, never gate
+                ok = (ok and bool(rb.get("zero_inexact"))
+                      and bool(rb.get("availability_ok"))
+                      and bool(rb.get("bitwise_parity_after_handoff")))
+        except (subprocess.TimeoutExpired, json.JSONDecodeError) as e:
+            if isinstance(e, json.JSONDecodeError):
+                detail = (child.stderr or child.stdout or "")[-1500:]
+            else:
+                raw = e.stderr or e.stdout or b""
+                detail = (raw.decode(errors="replace")
+                          if isinstance(raw, bytes) else str(raw))[-1500:]
+            report["replica_compare"] = {
                 "error": f"{str(e)[:300]} :: {detail}"}
     if a.routing_bench:
         # same subprocess discipline: the routing child spawns its own pod
