@@ -49,6 +49,23 @@ SERVE_FLAGS = """
                     prune radius; see docs/TUNING.md "Query locality"
   --max-batch N     widest padded query batch / shape bucket (default 1024)
   --min-batch N     narrowest shape bucket (default 8)
+  --num-slabs N     tiered slab index (beyond-HBM streaming; default 0 =
+                    fully resident): split the index into N row slabs and
+                    serve them through the device/host-RAM/mmap slab pool
+                    (serve/slabpool.py) — bit-identical to fully resident
+                    at every budget; a cold slab STALLS, never
+                    approximates (docs/SERVING.md "Tiered slab index")
+  --device-slab-budget B  device-memory budget in bytes for the resident
+                    slab working set (suffixes k/m/g; 0 = unbounded),
+                    counted against each slab engine's reported
+                    device_bytes footprint; LRU-with-pin eviction
+  --host-pool-slabs N  host-RAM row-pool capacity in slabs (0 =
+                    unbounded); slabs past it re-read from the mmap/file
+                    cold tier
+  --prefetch-depth N  next-nearest slabs promoted asynchronously per
+                    dispatched batch (default 1; the batcher additionally
+                    announces the next batch's routed slab set a batch
+                    ahead — docs/TUNING.md "Tiered slab index")
   --max-delay-ms F  micro-batch flush deadline (default 2.0)
   --pipeline-depth N  batches in flight between dispatch and demux
                     (default 2: next batch's device traversal overlaps the
@@ -102,12 +119,21 @@ def usage(error: str) -> "NoReturn":  # noqa: F821
     sys.exit(1)
 
 
+def parse_bytes(text: str) -> int:
+    """'268435456', '256m', '2g', '64k' -> bytes (suffixes are binary)."""
+    t = text.strip().lower()
+    mult = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30}.get(t[-1:], 1)
+    return int(float(t[:-1] if mult > 1 else t) * mult)
+
+
 def parse_serve_args(argv: list[str]) -> dict:
     opt = {"k": 0, "max_radius": math.inf, "in_path": "", "port": 8080,
            "host": "127.0.0.1", "engine": "auto", "merge": "auto",
            "score_dtype": "f32", "shards": None,
            "bucket_size": 0, "query_buckets": 0,
            "max_batch": 1024, "min_batch": 8,
+           "num_slabs": 0, "device_slab_budget": 0,
+           "host_pool_slabs": 0, "prefetch_depth": 1,
            "max_delay_ms": 2.0, "pipeline_depth": 2,
            "max_queue_rows": 4096, "seq_timeout_s": None,
            "timeout_ms": 5000.0, "warmup": True, "timings": False,
@@ -144,6 +170,14 @@ def parse_serve_args(argv: list[str]) -> dict:
                 i += 1; opt["max_batch"] = int(argv[i])
             elif arg == "--min-batch":
                 i += 1; opt["min_batch"] = int(argv[i])
+            elif arg == "--num-slabs":
+                i += 1; opt["num_slabs"] = int(argv[i])
+            elif arg == "--device-slab-budget":
+                i += 1; opt["device_slab_budget"] = parse_bytes(argv[i])
+            elif arg == "--host-pool-slabs":
+                i += 1; opt["host_pool_slabs"] = int(argv[i])
+            elif arg == "--prefetch-depth":
+                i += 1; opt["prefetch_depth"] = int(argv[i])
             elif arg == "--max-delay-ms":
                 i += 1; opt["max_delay_ms"] = float(argv[i])
             elif arg == "--pipeline-depth":
@@ -188,6 +222,17 @@ def parse_serve_args(argv: list[str]) -> dict:
     if opt["standby"] and opt["routing"] != "bounds":
         usage("--standby is the routed tier's slab-handoff target — "
               "launch with --routing bounds")
+    if opt["num_slabs"] < 0:
+        usage(f"--num-slabs must be >= 0, got {opt['num_slabs']}")
+    if opt["num_slabs"] > 0:
+        if opt["num_hosts"] > 1 and opt["routing"] != "bounds":
+            usage("--num-slabs (tiered slab streaming) does not combine "
+                  "with the pod-collective mode — the streamed slab set "
+                  "varies per batch, a pod-wide SPMD program cannot; use "
+                  "--routing bounds hosts (each streams its own slab)")
+        if opt["standby"]:
+            usage("--standby hosts materialize their engine at adoption "
+                  "time — launch the adopted engine without --num-slabs")
     return opt
 
 
@@ -245,8 +290,39 @@ def main(argv: list[str] | None = None) -> int:
             server.close()
         return 0
 
+    streaming = opt["num_slabs"] > 0
     id_offset = 0
-    if routed:
+    if routed and streaming:
+        # beyond-HBM routed host: load THIS host's row slab once, then
+        # stream it as --num-slabs sub-slabs through the tiered pool —
+        # the host's device budget no longer caps its slab size
+        # (docs/SERVING.md "Tiered slab index")
+        from mpi_cuda_largescaleknn_tpu.serve.engine import load_slab_rows
+        from mpi_cuda_largescaleknn_tpu.serve.slabpool import (
+            StreamingKnnEngine,
+        )
+
+        if not (0 <= opt["host_id"] < opt["num_hosts"]):
+            usage(f"--host-id {opt['host_id']} outside [0, "
+                  f"{opt['num_hosts']})")
+        rows, id_offset, n_total = load_slab_rows(
+            opt["in_path"], opt["host_id"], opt["num_hosts"])
+        engine = StreamingKnnEngine(
+            points=rows, num_slabs=opt["num_slabs"], k=opt["k"],
+            device_slab_budget=opt["device_slab_budget"],
+            host_pool_slabs=opt["host_pool_slabs"],
+            prefetch_depth=opt["prefetch_depth"],
+            mesh=get_mesh(opt["shards"]), engine=opt["engine"],
+            bucket_size=opt["bucket_size"], max_radius=opt["max_radius"],
+            max_batch=opt["max_batch"], min_batch=opt["min_batch"],
+            merge=opt["merge"], query_buckets=opt["query_buckets"],
+            score_dtype=opt["score_dtype"], id_offset=id_offset,
+            emit="candidates")
+        print(f"routed host {opt['host_id']}/{opt['num_hosts']}: streaming"
+              f" rows [{id_offset}:{id_offset + engine.n_points}) of "
+              f"{n_total} as {opt['num_slabs']} slabs (device budget "
+              f"{opt['device_slab_budget'] or 'unbounded'} B)")
+    elif routed:
         # shard-local routing: this process owns ONE row slab of the index
         # and serves it independently — no global mesh, global neighbor
         # ids via the engine's id offset, full candidate rows emitted for
@@ -273,6 +349,30 @@ def main(argv: list[str] | None = None) -> int:
         print(f"routed host {opt['host_id']}/{opt['num_hosts']}: loaded "
               f"rows [{id_offset}:{id_offset + engine.n_points}) of "
               f"{n_total} from {opt['in_path']}")
+    elif streaming:
+        # single-process beyond-HBM serving: the index stays in the
+        # source file (mmap cold tier) + a bounded host-RAM pool; only
+        # --device-slab-budget bytes of slab engines are resident at once
+        from mpi_cuda_largescaleknn_tpu.serve.slabpool import (
+            StreamingKnnEngine,
+        )
+
+        engine = StreamingKnnEngine(
+            opt["in_path"], num_slabs=opt["num_slabs"], k=opt["k"],
+            device_slab_budget=opt["device_slab_budget"],
+            host_pool_slabs=opt["host_pool_slabs"],
+            prefetch_depth=opt["prefetch_depth"],
+            mesh=get_mesh(opt["shards"]), engine=opt["engine"],
+            bucket_size=opt["bucket_size"], max_radius=opt["max_radius"],
+            max_batch=opt["max_batch"], min_batch=opt["min_batch"],
+            merge=opt["merge"], query_buckets=opt["query_buckets"],
+            score_dtype=opt["score_dtype"])
+        n_total = engine.n_points
+        print(f"tiered index: {n_total} points from {opt['in_path']} in "
+              f"{opt['num_slabs']} slabs ({engine.slab_device_bytes} B "
+              f"per resident slab; device budget "
+              f"{opt['device_slab_budget'] or 'unbounded'} B, host pool "
+              f"{opt['host_pool_slabs'] or 'unbounded'} slabs)")
     else:
         points = read_points(opt["in_path"])
         n_total = len(points)

@@ -37,6 +37,12 @@ amortizes it across a request stream:
                  drop / close-mid-body injectors on every serving
                  handler; ``KNN_FAULTS`` env or POST /faults) so every
                  failure path is testable without real process kills.
+- ``slabpool`` — beyond-HBM tiered slab index: a device-budget-bounded
+                 working set of slab engines over a host-RAM row pool
+                 over the mmap'd source file, LRU-with-pin eviction,
+                 async bounds-driven prefetch, and an engine-shaped
+                 streaming facade — bit-identical to fully-resident at
+                 every pool size (a miss stalls, never approximates).
 
 TPU-KNN (arXiv:2206.14286) reaches peak FLOP/s only with large fixed-shape
 query batches; PANDA (arXiv:1607.08220) frames distributed kNN as a

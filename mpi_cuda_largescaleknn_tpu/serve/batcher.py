@@ -113,6 +113,16 @@ class DynamicBatcher:
         self.pipelined = (self.pipeline_depth > 1
                           and hasattr(query_fn, "dispatch")
                           and hasattr(query_fn, "complete"))
+        #: streaming engines (serve/slabpool.py) expose ``prefetch_hint``:
+        #: after each dispatch the worker announces the still-QUEUED rows
+        #: — the next batch's content — so the engine's slab pool promotes
+        #: that batch's routed slab set under the in-flight batch's
+        #: compute (the graceful wrapper is looked through: hints go to
+        #: the engine, not the degradation shim)
+        self._prefetch_fn = (
+            getattr(query_fn, "prefetch_hint", None)
+            or getattr(getattr(query_fn, "engine", None),
+                       "prefetch_hint", None))
         self._cond = threading.Condition()
         # queue + counters shared between submitter threads and the
         # dispatch/completion workers: every access is under _cond
@@ -132,6 +142,7 @@ class DynamicBatcher:
         self._inflight_rows: guarded_by("_cond") = 0
         self.dispatch_stalls: guarded_by("_cond") = 0
         self.dispatch_stall_seconds: guarded_by("_cond") = 0.0
+        self.prefetch_hint_errors: guarded_by("_cond") = 0
         self.stall_hist = (timers.hist("pipeline_stall_seconds")
                            if timers is not None else LatencyHistogram())
         # time spent blocked inside query_fn.complete — for routed
@@ -364,6 +375,33 @@ class DynamicBatcher:
                 self._slots.release()
                 continue
             self._inflight.put((live, len(merged), handle, t0))
+            self._announce_prefetch()
+
+    def _announce_prefetch(self):
+        """Announce the queued rows — the NEXT batch's likely content —
+        to a streaming engine's prefetcher right after a dispatch, so
+        slab promotions overlap the batch just launched
+        (serve/slabpool.py). Advisory only: a hint failure is counted,
+        never allowed to fail the dispatched batch."""
+        if self._prefetch_fn is None:
+            return
+        with self._cond:
+            if not self._queue:
+                return
+            pending, rows = [], 0
+            for r in self._queue:
+                if rows + r.rows > self.max_batch:
+                    break
+                pending.append(r.queries)
+                rows += r.rows
+        if not pending:
+            return
+        try:
+            self._prefetch_fn(pending[0] if len(pending) == 1
+                              else np.concatenate(pending))
+        except Exception:  # noqa: BLE001 - advisory; counted below
+            with self._cond:
+                self.prefetch_hint_errors += 1
 
     def _run_complete(self):
         """Completion loop: block on the oldest in-flight batch, demux.
@@ -436,6 +474,7 @@ class DynamicBatcher:
                 "dispatch_stalls": self.dispatch_stalls,
                 "dispatch_stall_seconds": round(
                     self.dispatch_stall_seconds, 6),
+                "prefetch_hint_errors": self.prefetch_hint_errors,
                 "complete_seconds_total": round(
                     self.complete_hist.sum_seconds, 6),
             }

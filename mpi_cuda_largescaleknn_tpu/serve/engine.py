@@ -92,6 +92,69 @@ class UnservableShapeError(ValueError):
     layer should have rejected or split it)."""
 
 
+class ExecutableCache:
+    """Shared AOT-executable cache keyed by resident-set SHAPE CLASS.
+
+    A compiled query program is specialized to the SHAPES of its resident
+    operands, not their values — so engines whose resident arrays share a
+    shape class (same per-shard padding, bucket geometry, dim, dtype; the
+    tiered slab pool pads every slab engine to a common class exactly for
+    this) can reuse ONE executable. The pool hands every slab engine the
+    same cache; an eviction/re-promotion cycle then never recompiles, and
+    ``compiles`` is the pool-wide recompile-freedom counter the streaming
+    tests assert on (serve/slabpool.py)."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        # keys carry every program-identity component (engine, merge,
+        # qpad, query buckets, score dtype, emit, k, radius, tie order,
+        # dim) PLUS the resident arg shapes/dtypes — all reads and writes
+        # under the lock (promotion thread vs stall-path builders)
+        self._cache: guarded_by("_cv") = {}
+        #: keys some caller is currently compiling — a concurrent miss
+        #: WAITS for the build instead of paying a duplicate
+        #: seconds-long XLA compile (and double-counting ``compiles``,
+        #: the recompile-freedom number the tests pin)
+        self._building: guarded_by("_cv") = set()
+        self.compiles: guarded_by("_cv") = 0
+        self.hits: guarded_by("_cv") = 0
+
+    def get(self, key):
+        """Return the cached executable, or None with the key CLAIMED
+        for building — the caller then MUST ``put`` (or ``abort`` on
+        failure) so parked waiters wake."""
+        with self._cv:
+            while True:
+                exe = self._cache.get(key)
+                if exe is not None:
+                    self.hits += 1
+                    return exe
+                if key in self._building:
+                    self._cv.wait(0.05)
+                    continue
+                self._building.add(key)
+                return None
+
+    def put(self, key, exe) -> None:
+        with self._cv:
+            self._cache.setdefault(key, exe)
+            self._building.discard(key)
+            self.compiles += 1
+            self._cv.notify_all()
+
+    def abort(self, key) -> None:
+        """Release a claimed key after a failed compile (waiters retry)."""
+        with self._cv:
+            self._building.discard(key)
+            self._cv.notify_all()
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {"programs": len(self._cache),
+                    "compiles": self.compiles, "hits": self.hits,
+                    "shapes": sorted({k[2] for k in self._cache})}
+
+
 class _InFlightBatch:
     """A dispatched-but-uncompleted engine call (``dispatch`` -> ``complete``).
 
@@ -141,7 +204,10 @@ class ResidentKnnEngine:
                  max_radius: float = math.inf, max_batch: int = 1024,
                  min_batch: int = 8, merge: str = "auto",
                  query_buckets: int = 0, score_dtype: str = "f32",
-                 id_offset: int = 0, emit: str = "final"):
+                 id_offset: int = 0, emit: str = "final",
+                 timers: PhaseTimers | None = None,
+                 executable_cache: ExecutableCache | None = None,
+                 pad_shard_rows: int = 0):
         import jax
 
         from mpi_cuda_largescaleknn_tpu.ops.distance import (
@@ -274,7 +340,19 @@ class ResidentKnnEngine:
         #: equal-distance id CHOICES may then differ across geometries)
         self.canonical_ties = (use_tiled
                                and self.id_offset + self.n_points < (1 << 24))
-        self.timers = PhaseTimers()
+        #: shared timers/counters sink: the tiered slab pool hands every
+        #: slab engine ONE PhaseTimers so fetch/result/tile accounting
+        #: accumulates across promotions and evictions instead of dying
+        #: with each evicted engine (serve/slabpool.py)
+        self.timers = timers if timers is not None else PhaseTimers()
+        #: shared AOT cache (None = private per-engine dict only): slab
+        #: engines of one pool share compiled programs per shape class
+        self._exec_cache = executable_cache
+        #: pad each local shard to at least this many rows — the slab
+        #: pool's common shape class, so every slab engine lowers to
+        #: identical program shapes (single-host engines only; pod mode
+        #: already pads to the global max slab)
+        self._pad_shard_rows = int(pad_shard_rows)
         self._lock = threading.Lock()
         # mutable engine identity: a mid-stream Pallas degradation
         # (degrade()) swaps engine_name while dispatches and /stats
@@ -359,9 +437,13 @@ class ResidentKnnEngine:
         else:
             self._my_pos = list(range(self.num_shards))
             shards = [points[b:e] for b, e in bounds]
+            pad_to = None
+            if self._pad_shard_rows:
+                pad_to = max(self._pad_shard_rows, 1,
+                             max((len(s) for s in shards), default=1))
             flat, ids, _counts, self.npad_local = pad_and_flatten(
                 shards, id_bases=[b + self.id_offset for b, _ in bounds],
-                dim=self.dim)
+                pad_to=pad_to, dim=self.dim)
             # the flat resident side serves the bruteforce engine; the
             # bucketed one serves the tiled engines — both stay
             # device-resident for the life of the process (the reference
@@ -383,6 +465,16 @@ class ResidentKnnEngine:
             # over the component axis), single- and multi-host alike
             self._bucket_norms2 = jax.jit(norms2)(self._buckets.pts)
         self._replicated = NamedSharding(self.mesh, P())
+        #: this engine's device-resident byte footprint — flat arrays,
+        #: bucketed partition, and the precomputed norms (summed over the
+        #: whole mesh). The tiered slab pool budgets device memory against
+        #: exactly this number, and /stats reports it per slab so
+        #: ``knn_slab_pool_resident`` has a truthful denominator.
+        resident = [self._flat_pts, self._flat_ids, *self._buckets]
+        if self._bucket_norms2 is not None:
+            resident.append(self._bucket_norms2)
+        self.device_bytes = int(sum(
+            int(np.prod(a.shape)) * a.dtype.itemsize for a in resident))
 
     def _stage_replicated(self, q: np.ndarray):
         """Upload a host batch replicated to every mesh device. Single
@@ -576,13 +668,41 @@ class ResidentKnnEngine:
         exe = self._executables.get(key)
         if exe is not None:
             return exe
-        with self.timers.phase(f"compile_q{qpad}"):
-            fn = self._build_query_fn(engine_name, qpad, qb)
-            q0 = self._stage_replicated(
-                np.full((qpad, self.dim), PAD_SENTINEL, np.float32))
-            exe = fn.lower(*self._resident_args(engine_name),
-                           q0).compile()
+        shared_key = None
+        if self._exec_cache is not None:
+            # the shared key adds every remaining program-identity knob
+            # plus the resident operands' SHAPE CLASS: a compiled program
+            # binds shapes, not values, so any engine of the same class
+            # (the pool pads all slabs to one) can run it on its own
+            # resident arrays
+            args = self._resident_args(engine_name)
+            shared_key = key + (
+                self.emit, self.k, self.max_radius, self.canonical_ties,
+                self.dim,
+                tuple((tuple(a.shape), str(a.dtype)) for a in args))
+            exe = self._exec_cache.get(shared_key)
+            if exe is not None:
+                self._executables[key] = exe
+                with self._meta_lock:
+                    self._compiled_shapes.append(qpad)
+                return exe
+            # None = this engine CLAIMED the shared key: concurrent
+            # misses (another slab's promotion) park in get() until the
+            # put below — or the abort, if the compile fails
+        try:
+            with self.timers.phase(f"compile_q{qpad}"):
+                fn = self._build_query_fn(engine_name, qpad, qb)
+                q0 = self._stage_replicated(
+                    np.full((qpad, self.dim), PAD_SENTINEL, np.float32))
+                exe = fn.lower(*self._resident_args(engine_name),
+                               q0).compile()
+        except BaseException:
+            if self._exec_cache is not None:
+                self._exec_cache.abort(shared_key)
+            raise
         self._executables[key] = exe
+        if self._exec_cache is not None:
+            self._exec_cache.put(shared_key, exe)
         with self._meta_lock:
             self.compile_count += 1
             self._compiled_shapes.append(qpad)
@@ -965,6 +1085,9 @@ class ResidentKnnEngine:
             "max_radius": (None if math.isinf(self.max_radius)
                            else self.max_radius),
             "shard_bounds": self.shard_bounds,
+            # per-slab device byte footprint (flat + bucketed + norms):
+            # what the tiered slab pool's --device-slab-budget counts
+            "device_bytes": self.device_bytes,
             "max_batch": self.max_batch,
             "bucket_size": self.bucket_size,
             "shape_buckets": list(self.shape_buckets),
@@ -991,6 +1114,29 @@ class ResidentKnnEngine:
             "result_rows": self.timers.counter("result_rows"),
             "timers": self.timers.report(),
         }
+
+
+def load_slab_rows(path: str, host_id: int, num_hosts: int):
+    """Load row slab ``[N*i/H, N*(i+1)/H)`` of ``path``; returns
+    ``(points f32[n, D], begin, n_total)``.
+
+    The ONE slab-split read every slab consumer shares —
+    ``materialize_slab_engine`` (routed hosts + /adopt_slab handoff), the
+    routed streaming path (serve_main ``--num-slabs`` on a routed host),
+    and the slab pool's cold tier (serve/slabpool.py ``SlabSource``): the
+    reference's ``read_file_portion`` integer split for ``.float3``
+    (identical arithmetic to ``slab_bounds``), an mmap slice for
+    ``.npy`` — so every consumer materializes byte-identical rows."""
+    if path.endswith(".npy"):
+        from mpi_cuda_largescaleknn_tpu.models.sharding import slab_bounds
+
+        arr = np.load(path, mmap_mode="r")
+        n_total = len(arr)
+        begin, end = slab_bounds(n_total, num_hosts)[host_id]
+        return np.asarray(arr[begin:end], np.float32), begin, n_total
+    from mpi_cuda_largescaleknn_tpu.io.reader import read_file_portion
+
+    return read_file_portion(path, host_id, num_hosts)
 
 
 def materialize_slab_engine(path, host_id: int, num_hosts: int, *, k: int,
@@ -1020,22 +1166,8 @@ def materialize_slab_engine(path, host_id: int, num_hosts: int, *, k: int,
     if points is None:
         if not path:
             raise ValueError("need an input path or pre-loaded slab rows")
-        if path.endswith(".npy"):
-            from mpi_cuda_largescaleknn_tpu.models.sharding import (
-                slab_bounds,
-            )
-
-            arr = np.load(path, mmap_mode="r")
-            n_total = len(arr)
-            id_offset, end = slab_bounds(n_total, num_hosts)[host_id]
-            points = np.asarray(arr[id_offset:end], np.float32)
-        else:
-            from mpi_cuda_largescaleknn_tpu.io.reader import (
-                read_file_portion,
-            )
-
-            points, id_offset, n_total = read_file_portion(
-                path, host_id, num_hosts)
+        points, id_offset, n_total = load_slab_rows(path, host_id,
+                                                    num_hosts)
     else:
         if id_offset is None:
             raise ValueError("pre-loaded slab rows need their id_offset "

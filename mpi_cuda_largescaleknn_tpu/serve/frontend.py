@@ -127,6 +127,7 @@ from mpi_cuda_largescaleknn_tpu.serve.server import (
     JsonHttpHandler,
     ServingMetrics,
     parse_knn_body,
+    slab_pool_prometheus_lines,
 )
 from mpi_cuda_largescaleknn_tpu.utils.math import aabb_lower_bound_dist2
 
@@ -448,6 +449,10 @@ class _HostHandler(JsonHttpHandler):
                               ("knn_host_routed",
                                int(srv.routing == "bounds"))):
                 lines += [f"# TYPE {name} gauge", f"{name} {val}"]
+            # a routed host may itself STREAM sub-slabs of its row range
+            # (serve_main --routing bounds --num-slabs): surface its
+            # tiered-pool counters with the single-host server's renderer
+            lines += slab_pool_prometheus_lines(e)
             self._send(200, ("\n".join(lines) + "\n").encode(),
                        "text/plain; version=0.0.4")
         else:
@@ -1197,7 +1202,7 @@ class RoutedPodFanout(PodFanout):
                     ep.routed_rows += len(rows)
                 ep.health.note_success()
                 dts.append(dt)
-                _fold_candidates(cur_d2, cur_idx, rows, d2, idx, k)
+                fold_candidates(cur_d2, cur_idx, rows, d2, idx, k)
             r2 = cur_d2[:, k - 1].astype(np.float64)
             need = (~visited) & reachable & (lb_safe <= r2[:, None])
             avail = self.replicas.slab_live_mask(
@@ -1268,13 +1273,15 @@ class RoutedPodFanout(PodFanout):
         return s
 
 
-def _fold_candidates(cur_d2, cur_idx, rows, d2, idx, k):
+def fold_candidates(cur_d2, cur_idx, rows, d2, idx, k):
     """Fold one host's candidate rows into the running per-query top-k
     under the canonical (dist2, id) total order — ops/candidates.py
     ``merge_candidates(canonical=True)`` in numpy. Commutative and
     associative (ids are unique), so wave/host arrival order can never
     change the folded bits; init slots (idx -1) still win their ties at
-    the radius cutoff, preserving the engines' strict-< adoption."""
+    the radius cutoff, preserving the engines' strict-< adoption. Shared
+    by the routed pod fan-out above and the tiered slab index's
+    in-process fold (serve/slabpool.py) — one fold, one tie discipline."""
     cat_d2 = np.concatenate([cur_d2[rows], np.asarray(d2, np.float32)],
                             axis=1)
     cat_idx = np.concatenate([cur_idx[rows], np.asarray(idx, np.int32)],
@@ -1282,6 +1289,10 @@ def _fold_candidates(cur_d2, cur_idx, rows, d2, idx, k):
     order = np.lexsort((cat_idx, cat_d2), axis=1)[:, :k]
     cur_d2[rows] = np.take_along_axis(cat_d2, order, axis=1)
     cur_idx[rows] = np.take_along_axis(cat_idx, order, axis=1)
+
+
+#: pre-slabpool private name, kept for external callers/tests
+_fold_candidates = fold_candidates
 
 
 class FrontendServer(ThreadingHTTPServer):

@@ -75,6 +75,42 @@ def parse_knn_body(path: str, headers, rfile, dim: int = 3):
     return q, bool(obj.get("neighbors", neighbors)), timeout_ms / 1e3, False
 
 
+def slab_pool_prometheus_lines(engine_stats: dict) -> list[str]:
+    """Prometheus lines for the tiered slab index (serve/slabpool.py),
+    empty when the engine is fully resident. Shared by the single-host
+    server's /metrics and the routed host's (serve/frontend.py), so the
+    pool reads the same on every serving tier."""
+    pool = engine_stats.get("slab_pool")
+    if not pool:
+        return []
+    return [
+        "# TYPE knn_slab_pool_resident gauge",
+        f'knn_slab_pool_resident{{tier="device"}} '
+        f'{pool["device_resident"]}',
+        f'knn_slab_pool_resident{{tier="host"}} {pool["host_resident"]}',
+        "# TYPE knn_slab_pool_device_bytes gauge",
+        f'knn_slab_pool_device_bytes {pool["device_bytes_used"]}',
+        "# TYPE knn_slab_pool_device_budget_bytes gauge",
+        f'knn_slab_pool_device_budget_bytes '
+        f'{pool["device_budget_bytes"]}',
+        "# TYPE knn_slab_promotions_total counter",
+        f'knn_slab_promotions_total {pool["promotions"]}',
+        "# TYPE knn_slab_evictions_total counter",
+        f'knn_slab_evictions_total {pool["evictions"]}',
+        "# TYPE knn_stream_stalls_total counter",
+        f'knn_stream_stalls_total {pool["stream_stalls"]}',
+        "# TYPE knn_stream_stall_seconds_total counter",
+        f'knn_stream_stall_seconds_total {pool["stream_stall_seconds"]}',
+        "# TYPE knn_slab_pool_hits_total counter",
+        f'knn_slab_pool_hits_total{{tier="device"}} {pool["device_hits"]}',
+        f'knn_slab_pool_hits_total{{tier="host"}} {pool["host_hits"]}',
+        "# TYPE knn_slab_pool_cold_reads_total counter",
+        f'knn_slab_pool_cold_reads_total {pool["cold_reads"]}',
+        "# TYPE knn_slab_prefetch_enqueued_total counter",
+        f'knn_slab_prefetch_enqueued_total {pool["prefetch_enqueued"]}',
+    ]
+
+
 class ServingMetrics:
     def __init__(self):
         self._lock = threading.Lock()
@@ -278,6 +314,10 @@ class _Handler(JsonHttpHandler):
         }
         for name, val in gauges.items():
             lines += [f"# TYPE {name} gauge", f"{name} {val}"]
+        # tiered slab index (serve/slabpool.py): per-tier residency,
+        # promotion/eviction totals, stream-stall accounting — absent for
+        # fully-resident engines
+        lines += slab_pool_prometheus_lines(e)
         lines += srv.metrics.latency.prometheus_lines(
             "knn_request_latency_seconds")
         for src, prom in (("engine_batch_seconds",

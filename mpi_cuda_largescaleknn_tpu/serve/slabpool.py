@@ -1,0 +1,980 @@
+"""Beyond-HBM tiered slab index: host-RAM/mmap slab pool + bounds-driven
+prefetch streaming.
+
+The resident engines cap index size at device memory — every slab must be
+uploaded before the first query. But the reference's whole point is
+datasets that EXCEED one accelerator's memory (PAPER.md §0: billions of
+points; "queries never move — trees move"), and PANDA (arXiv:1607.08220)
+shows extreme-scale kNN is won by keeping the working set MOVING through a
+memory hierarchy rather than demanding full residency. This module tiers
+the index in three levels:
+
+- **device** — a working set of slab engines (one ``ResidentKnnEngine``
+  per resident slab, ``emit='candidates'`` with global neighbor ids),
+  bounded in BYTES by ``--device-slab-budget`` against each engine's
+  reported ``device_bytes`` footprint, evicted LRU-with-pin;
+- **host RAM** — a bounded pool of materialized numpy slab rows (the
+  promotion source; on real hardware these would be pinned/page-locked
+  buffers for DMA), LRU-capped at ``--host-pool-slabs``;
+- **cold** — the source ``.float3``/``.npy`` file itself (``SlabSource``:
+  the exact ``load_slab_rows`` split of serve/engine.py, mmap for
+  ``.npy``), so a slab that fell out of both warm tiers is re-read with
+  rows byte-identical to what a routed host / the slab handoff would
+  materialize.
+
+``StreamingKnnEngine`` is the engine-shaped facade the serving stack
+drives (same ``dispatch``/``complete`` split, same /stats-feeding
+``stats()`` surface): each batch is routed by a per-slab AABB bounds table
+— the in-process twin of the PR-7 ``PodBoundsTable`` — to its
+nearest-bounds slab plus every slab whose box contains it, the per-slab
+candidate partials are folded with the canonical (dist2, id) merge
+(serve/frontend.py ``fold_candidates`` — commutative, so slab completion
+order can never change bits), and uncertified (query, slab) pairs
+escalate in waves exactly like the routed pod
+(``lb * (1 - routing_cert_slack) <= kth²`` keeps a slab in play) until
+every skipped slab is CERTIFIED unable to contribute. Exactness is never
+traded: a needed slab that misses both warm tiers STALLS the batch
+(counted in ``knn_stream_stall_seconds_total``), it is never skipped or
+approximated — results are bit-identical to a fully-resident engine at
+EVERY pool size (tests/test_slabpool.py's parity matrix over budgets
+{1 slab, half, all}).
+
+Overlap is what makes the tiers affordable (TPU-KNN, arXiv:2206.14286:
+the scorer must never starve): ``dispatch`` PINS the batch's slab set
+(pinned slabs cannot evict while their programs are in flight), a
+dedicated promotion thread uploads prefetched slabs ASYNCHRONOUSLY, and
+the PR-2 pipeline announces the NEXT admitted batch's routed slab set a
+batch ahead (serve/batcher.py calls ``prefetch_hint`` with the queued
+rows after each dispatch) — so promotions ride under the in-flight
+batch's compute and a well-hinted stream stalls zero times
+(``serve_smoke --streaming-bench`` gates a stall-fraction ceiling at
+index size 4x the device budget, on top of bitwise probe parity).
+
+AOT discipline across the churn: all slab engines are padded to ONE shape
+class (``pad_shard_rows``) and share an ``ExecutableCache`` keyed by that
+class, so an eviction/re-promotion cycle reuses the already-compiled
+query programs — ``compile_count`` stays flat no matter how many times a
+slab cycles through the pool.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import queue
+import threading
+import time
+
+import numpy as np
+
+from mpi_cuda_largescaleknn_tpu.analysis import guarded_by
+from mpi_cuda_largescaleknn_tpu.obs.timers import PhaseTimers
+from mpi_cuda_largescaleknn_tpu.serve.faults import FaultInjector
+
+_RECORD_BYTES = 12  # one float3 (io/reader.py)
+
+
+class SlabSource:
+    """The cold tier: materialize slab ``s`` of ``S`` on demand.
+
+    Rows are byte-identical to ``serve/engine.py load_slab_rows`` (and
+    therefore to a routed host's / the slab handoff's materialization):
+    the reference's integer split ``[N*s/S, N*(s+1)/S)`` via
+    ``read_file_portion`` for ``.float3``, an mmap slice for ``.npy``, a
+    plain slice for an in-memory array (the routed streaming path hands
+    its already-loaded host slab here). Reads are stateless and
+    thread-compatible — the pool's locking lives above this."""
+
+    def __init__(self, *, path: str | None = None, points=None,
+                 num_slabs: int):
+        from mpi_cuda_largescaleknn_tpu.models.sharding import slab_bounds
+
+        if (path is None) == (points is None):
+            raise ValueError("need exactly one of path= or points=")
+        if num_slabs < 1:
+            raise ValueError(f"num_slabs must be >= 1, got {num_slabs}")
+        self.path = path
+        self.num_slabs = int(num_slabs)
+        self._points = None
+        self._mmap = None
+        if points is not None:
+            self._points = np.asarray(points, np.float32)
+            if self._points.ndim != 2 or self._points.shape[1] < 1:
+                raise ValueError(f"points must be [N, D], got "
+                                 f"{self._points.shape}")
+            self.n_total = len(self._points)
+            self.dim = int(self._points.shape[1])
+        elif path.endswith(".npy"):
+            self._mmap = np.load(path, mmap_mode="r")
+            if self._mmap.ndim != 2 or self._mmap.shape[1] < 1:
+                raise ValueError(f"{path}: expected an [N, D] array, got "
+                                 f"shape {list(self._mmap.shape)}")
+            self.n_total = len(self._mmap)
+            self.dim = int(self._mmap.shape[1])
+        else:
+            self.n_total = os.path.getsize(path) // _RECORD_BYTES
+            self.dim = 3
+        #: slab s owns global rows [bounds[s][0], bounds[s][1]) — the
+        #: reference's split, shared with every other slab consumer
+        self.bounds = slab_bounds(self.n_total, self.num_slabs)
+
+    def read(self, slab: int) -> np.ndarray:
+        """Materialize slab ``slab``'s rows (f32[n, dim])."""
+        b, e = self.bounds[slab]
+        if self._points is not None:
+            return np.asarray(self._points[b:e], np.float32)
+        if self._mmap is not None:
+            # the mmap slice copies exactly the slab's pages into RAM —
+            # the cold tier never loads the whole file
+            return np.asarray(self._mmap[b:e], np.float32)
+        from mpi_cuda_largescaleknn_tpu.io.reader import read_file_portion
+
+        rows, begin, _n = read_file_portion(self.path, slab, self.num_slabs)
+        assert begin == b, f"slab split drifted: {begin} != {b}"
+        return rows
+
+    def scan_aabbs(self, sink=None) -> list[dict]:
+        """One bounding box + count per slab ({"lo", "hi", "count"},
+        ``lo/hi = None`` for empty slabs — the router's unreachable
+        sentinel). Streams one slab at a time, so the scan's resident
+        footprint is one slab, not the index. ``sink(slab, rows)`` (if
+        given) receives each slab's rows as they are scanned — the
+        streaming engine seeds its pool's host tier with them, so the
+        scan's I/O is not immediately repeated by the first promotions."""
+        from mpi_cuda_largescaleknn_tpu.models.sharding import slab_aabbs
+
+        out = []
+        for s in range(self.num_slabs):
+            rows = self.read(s)
+            out.extend(slab_aabbs(rows, [(0, len(rows))]))
+            if sink is not None:
+                sink(s, rows)
+        return out
+
+
+class _DeviceSlab:
+    """One device-resident slab: its engine, its byte footprint, and its
+    LRU tick (a logical counter, not wall-clock — deterministic under the
+    tests' injectable clock)."""
+
+    __slots__ = ("engine", "bytes", "tick")
+
+    def __init__(self, engine, nbytes: int, tick: int):
+        self.engine = engine
+        self.bytes = int(nbytes)
+        self.tick = int(tick)
+
+
+class SlabPool:
+    """Tiered slab store: device engines over a host-RAM row pool over the
+    cold source, with LRU-with-pin eviction and an async promotion thread.
+
+    ``engine_factory(slab_id, rows, row_begin) -> engine`` builds the
+    device tier's entries (the streaming engine supplies the real
+    ``ResidentKnnEngine`` factory; unit tests inject fakes — no jax, no
+    sleeps). The factory runs OUTSIDE the pool lock: builds take real time
+    and must never block /stats scrapes or concurrent pins.
+
+    Exactness contract: ``ensure`` blocks until the slab is resident — a
+    miss is a counted STALL (``stream_stall_seconds``), never a skipped or
+    approximated slab. Pinned slabs (``pin``/``unpin``: the dispatch path
+    pins a batch's routed slab set for the life of its in-flight programs)
+    are never evicted; if a single batch's pinned set exceeds the budget
+    the pool overcommits transiently (counted) rather than deadlock —
+    the budget is a steady-state bound, not a per-batch straitjacket.
+    """
+
+    def __init__(self, source: SlabSource, engine_factory, *,
+                 device_budget_bytes: int = 0, host_pool_slabs: int = 0,
+                 faults: FaultInjector | None = None,
+                 clock=time.perf_counter):
+        self._source = source
+        self._factory = engine_factory
+        self._clock = clock
+        self._sleep = time.sleep  # injectable: fault tests never sleep
+        self._faults = faults
+        self._cv = threading.Condition()
+        # --- every field below is shared between caller threads (pin/
+        # ensure/stats) and the promotion thread; all access under _cv ---
+        self._budget: guarded_by("_cv") = int(device_budget_bytes)
+        self._host_cap: guarded_by("_cv") = int(host_pool_slabs)
+        self._device: guarded_by("_cv") = {}
+        self._device_bytes: guarded_by("_cv") = 0
+        #: host-RAM row pool, insertion-ordered oldest-first (dicts keep
+        #: insertion order; move-to-end on hit = LRU)
+        self._host: guarded_by("_cv") = {}
+        self._pins: guarded_by("_cv") = {}
+        self._promoting: guarded_by("_cv") = set()
+        self._queued: guarded_by("_cv") = set()
+        self._tick: guarded_by("_cv") = 0
+        self._closed: guarded_by("_cv") = False
+        self.promotions: guarded_by("_cv") = 0
+        self.promotion_errors: guarded_by("_cv") = 0
+        self.last_error: guarded_by("_cv") = None
+        self.evictions: guarded_by("_cv") = 0
+        self.host_evictions: guarded_by("_cv") = 0
+        self.device_hits: guarded_by("_cv") = 0
+        self.host_hits: guarded_by("_cv") = 0
+        self.cold_reads: guarded_by("_cv") = 0
+        self.overcommits: guarded_by("_cv") = 0
+        self.prefetch_enqueued: guarded_by("_cv") = 0
+        self.prefetch_errors: guarded_by("_cv") = 0
+        self.stream_stalls: guarded_by("_cv") = 0
+        self.stream_stall_seconds: guarded_by("_cv") = 0.0
+        self._pq: queue.Queue = queue.Queue()
+        self._thread = threading.Thread(target=self._prefetch_loop,
+                                        daemon=True, name="knn-slab-promote")
+        self._thread.start()
+
+    # ----------------------------------------------------------- accounting
+
+    def _next_tick(self) -> int:  # lsk: holds[_cv]
+        self._tick += 1
+        return self._tick
+
+    def _note_stall(self, seconds: float) -> None:  # lsk: holds[_cv]
+        self.stream_stalls += 1
+        self.stream_stall_seconds += max(0.0, float(seconds))
+
+    def _host_put(self, slab: int, rows) -> None:  # lsk: holds[_cv]
+        """Insert/refresh a slab's rows in the host tier; trim LRU past
+        the cap. Device-resident slabs keep their own row reference
+        (``engine.host_points``), so trimming here never loses data —
+        worst case the cold tier resupplies."""
+        self._host.pop(slab, None)
+        self._host[slab] = rows
+        if self._host_cap > 0:
+            while len(self._host) > self._host_cap:
+                victim = next(iter(self._host))
+                del self._host[victim]
+                self.host_evictions += 1
+
+    def _evict_to_fit(self, new_bytes: int) -> None:  # lsk: holds[_cv]
+        """Evict LRU unpinned device slabs until ``new_bytes`` more fit
+        the budget (0 = unbounded). Evicted engines demote their rows to
+        the host tier (free re-warm). With nothing evictable the pool
+        overcommits — a pinned set wider than the budget must complete,
+        not deadlock."""
+        if self._budget <= 0:
+            return
+        while self._device_bytes + new_bytes > self._budget and self._device:
+            victims = [(ent.tick, s) for s, ent in self._device.items()
+                       if self._pins.get(s, 0) == 0]
+            if not victims:
+                # counted per PROMOTION that lands over budget (insert
+                # time only) — unpin/set-budget re-checks finding the
+                # pool still over would overstate one wide batch as many
+                if new_bytes > 0:
+                    self.overcommits += 1
+                return
+            _tick, s = min(victims)
+            ent = self._device.pop(s)
+            self._device_bytes -= ent.bytes
+            self.evictions += 1
+            rows = getattr(ent.engine, "host_points", None)
+            if rows is not None:
+                self._host_put(s, rows)
+
+    # ------------------------------------------------------------- pin/ensure
+
+    def pin(self, slabs) -> None:
+        """Pin each slab against eviction (reference-counted). Pins apply
+        whether or not the slab is resident yet — a pinned cold slab
+        cannot be evicted between its promotion and its use."""
+        with self._cv:
+            for s in set(slabs):
+                self._pins[s] = self._pins.get(s, 0) + 1
+
+    def unpin(self, slabs) -> None:
+        with self._cv:
+            for s in set(slabs):
+                c = self._pins.get(s, 0) - 1
+                if c <= 0:
+                    self._pins.pop(s, None)
+                else:
+                    self._pins[s] = c
+            # a batch whose pinned set overcommitted the budget shrinks
+            # back the moment its pins release — the budget is the
+            # steady-state bound, enforced at every release point
+            self._evict_to_fit(0)
+            self._cv.notify_all()
+
+    def ensure(self, slab: int, count_stall: bool = True):
+        """Return the slab's resident engine, promoting it first if
+        needed. A promotion the caller had to WAIT for (cold/host miss, or
+        an in-flight promotion it parked behind) is a counted stall unless
+        ``count_stall=False`` (warmup/prefetch — data motion the stream
+        never waited on)."""
+        t0 = None
+        while True:
+            with self._cv:
+                ent = self._device.get(slab)
+                if ent is not None:
+                    ent.tick = self._next_tick()
+                    if t0 is None:
+                        self.device_hits += 1
+                    elif count_stall:
+                        self._note_stall(self._clock() - t0)
+                    return ent.engine
+                if slab in self._promoting:
+                    # another thread (usually the promotion worker) is
+                    # already building it — park until it lands
+                    if t0 is None:
+                        t0 = self._clock()
+                    self._cv.wait(0.05)
+                    continue
+                self._promoting.add(slab)
+                if t0 is None:
+                    t0 = self._clock()
+            break
+        try:
+            eng = self._build(slab)
+        except BaseException as e:
+            with self._cv:
+                self._promoting.discard(slab)
+                self.promotion_errors += 1
+                self.last_error = f"slab {slab}: {type(e).__name__}: {e}"
+                self._cv.notify_all()
+            raise
+        with self._cv:
+            self._evict_to_fit(eng.device_bytes)
+            self._device[slab] = _DeviceSlab(eng, eng.device_bytes,
+                                             self._next_tick())
+            self._device_bytes += int(eng.device_bytes)
+            self._promoting.discard(slab)
+            self.promotions += 1
+            if count_stall:
+                self._note_stall(self._clock() - t0)
+            self._cv.notify_all()
+        return eng
+
+    def acquire(self, slabs) -> dict:
+        """Ensure every slab of a routed set is resident; {slab: engine}."""
+        return {int(s): self.ensure(int(s)) for s in slabs}
+
+    def _build(self, slab: int):
+        """Materialize rows (host tier first, cold source on miss) and
+        build the slab's engine. Runs with NO pool lock held."""
+        b, _e = self._source.bounds[slab]
+        with self._cv:
+            rows = self._host.get(slab)
+            if rows is not None:
+                self._host.pop(slab)
+                self._host[slab] = rows  # move-to-end = LRU refresh
+                self.host_hits += 1
+        if rows is None:
+            rows = self._source.read(slab)
+            with self._cv:
+                self.cold_reads += 1
+                self._host_put(slab, rows)
+        self._maybe_fault(slab)
+        return self._factory(slab, rows, b)
+
+    def _maybe_fault(self, slab: int) -> None:
+        """Deterministic promotion faults (serve/faults.py): ``latency``
+        slows the upload (the slow-promotion stall drill), any other op
+        fails it — both on the same seeded grammar the HTTP handlers
+        use, keyed as ``PROMOTE /slab/<id>``."""
+        if self._faults is None or not self._faults.active():
+            return
+        spec = self._faults.decide(f"/slab/{slab}", "PROMOTE")
+        if spec is None:
+            return
+        if spec.op == "latency":
+            self._sleep(spec.delay_s)
+        else:
+            raise RuntimeError(f"injected promotion fault: {spec.op}")
+
+    # -------------------------------------------------------------- prefetch
+
+    def prefetch(self, slabs) -> None:
+        """Enqueue async promotions (dedup against resident / in-flight /
+        already-queued). The promotion thread uploads them under the
+        in-flight batch's compute; a prefetched slab later ``ensure``d is
+        a device hit — zero stall."""
+        todo = []
+        with self._cv:
+            if self._closed:
+                return
+            for s in slabs:
+                s = int(s)
+                ent = self._device.get(s)
+                if ent is not None:
+                    # a hint declares the WHOLE set hot: refresh resident
+                    # members' LRU ticks so promoting the missing ones
+                    # cannot evict a sibling of the same hinted set
+                    ent.tick = self._next_tick()
+                    continue
+                if s in self._promoting or s in self._queued:
+                    continue
+                self._queued.add(s)
+                todo.append(s)
+            self.prefetch_enqueued += len(todo)
+        for s in todo:
+            self._pq.put(s)
+
+    def _prefetch_loop(self) -> None:
+        while True:
+            s = self._pq.get()
+            if s is None:
+                return
+            try:
+                self.ensure(s, count_stall=False)
+            except Exception:
+                # ensure's failure path already recorded the cause in
+                # promotion_errors/last_error; count the PREFETCH-path
+                # share separately and survive — a dead promotion thread
+                # would turn every later miss into a stall
+                with self._cv:
+                    self.prefetch_errors += 1
+            finally:
+                # dequeue only AFTER the promotion finished (or failed):
+                # discarding before ensure() marks _promoting would open
+                # a window where wait_idle sees both sets empty and
+                # reports idle mid-build
+                with self._cv:
+                    self._queued.discard(s)
+                    self._cv.notify_all()
+
+    def seed_host(self, slab: int, rows) -> None:
+        """Pre-populate the host tier (LRU-capped as usual) without
+        touching the hit/miss counters — the startup AABB scan already
+        read these rows, so the first promotions should not re-read the
+        cold tier for them."""
+        with self._cv:
+            self._host_put(int(slab), rows)
+
+    def warm_fill(self, slabs, est_bytes: int) -> list[int]:
+        """Promote slabs in order until the next would exceed the budget
+        (``est_bytes`` = one slab's footprint; all pool slabs share a
+        shape class, so one estimate covers them). Synchronous and
+        stall-free by definition — this is warmup, the stream has not
+        started."""
+        done = []
+        for s in slabs:
+            with self._cv:
+                if s in self._device:
+                    continue
+                if (self._budget > 0
+                        and self._device_bytes + est_bytes > self._budget):
+                    break
+            self.ensure(int(s), count_stall=False)
+            done.append(int(s))
+        return done
+
+    def wait_idle(self, timeout_s: float = 30.0) -> bool:
+        """Block until no promotion is queued or in flight (tests + the
+        prefetch-overlap bench use this to separate 'announced ahead'
+        from 'stalled on')."""
+        deadline = time.monotonic() + timeout_s
+        with self._cv:
+            while self._queued or self._promoting:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(min(remaining, 0.05))
+            return True
+
+    # ----------------------------------------------------------------- admin
+
+    def set_device_budget(self, nbytes: int) -> None:
+        """Retune the device budget at runtime; shrinking evicts LRU
+        unpinned slabs immediately."""
+        with self._cv:
+            self._budget = int(nbytes)
+            self._evict_to_fit(0)
+
+    def resident_engines(self) -> list:
+        with self._cv:
+            return [ent.engine for ent in self._device.values()]
+
+    def resident_slabs(self) -> list[int]:
+        with self._cv:
+            return sorted(self._device)
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+        self._pq.put(None)
+        self._thread.join(timeout=10)
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {
+                "num_slabs": self._source.num_slabs,
+                "device_resident": len(self._device),
+                "host_resident": len(self._host),
+                "device_bytes_used": self._device_bytes,
+                "device_budget_bytes": self._budget,
+                "host_pool_slabs": self._host_cap,
+                "resident_slabs": sorted(self._device),
+                "pinned_slabs": sorted(self._pins),
+                "promotions": self.promotions,
+                "promotion_errors": self.promotion_errors,
+                "last_error": self.last_error,
+                "evictions": self.evictions,
+                "host_evictions": self.host_evictions,
+                "device_hits": self.device_hits,
+                "host_hits": self.host_hits,
+                "cold_reads": self.cold_reads,
+                "overcommits": self.overcommits,
+                "prefetch_enqueued": self.prefetch_enqueued,
+                "prefetch_errors": self.prefetch_errors,
+                "stream_stalls": self.stream_stalls,
+                "stream_stall_seconds": round(self.stream_stall_seconds, 6),
+            }
+
+
+class _StreamHandle:
+    """A dispatched-but-uncompleted streaming batch: the original queries
+    (degradation replay + escalation sub-batches), the bounds table's
+    lower bounds, the visited matrix, the per-slab in-flight sub-batches,
+    and the pinned slab set ``complete`` releases."""
+
+    __slots__ = ("queries", "n", "engine_name", "t0", "lb", "visited",
+                 "subs", "pinned")
+
+    def __init__(self, queries, n, engine_name, t0):
+        self.queries = queries
+        self.n = n
+        self.engine_name = engine_name
+        self.t0 = t0
+        self.lb = None
+        self.visited = None
+        self.subs = []
+        self.pinned = set()
+
+
+class StreamingKnnEngine:
+    """Engine facade over a ``SlabPool``: serve an index bigger than
+    device memory, bit-identical to a fully-resident engine.
+
+    Same ``dispatch``/``complete``/``query`` contract as
+    ``ResidentKnnEngine`` (the batcher, admission wrapper, and HTTP
+    server drive it unchanged); ``emit='candidates'`` additionally serves
+    ``complete_candidates`` so a routed pod host can itself stream
+    sub-slabs (serve_main ``--routing bounds --num-slabs``). Thread
+    compatibility matches the resident engine's: the batcher's dispatch
+    and completion workers may overlap one batch's escalation with the
+    next batch's wave 1 — the pool lock and each slab engine's own lock
+    serialize what must serialize.
+    """
+
+    def __init__(self, path: str | None = None, *, points=None,
+                 num_slabs: int, k: int, device_slab_budget: int = 0,
+                 host_pool_slabs: int = 0, prefetch_depth: int = 1,
+                 mesh=None, engine: str = "auto", bucket_size: int = 0,
+                 max_radius: float = math.inf, max_batch: int = 1024,
+                 min_batch: int = 8, merge: str = "auto",
+                 query_buckets: int = 0, score_dtype: str = "f32",
+                 id_offset: int = 0, emit: str = "final",
+                 faults: FaultInjector | None = None,
+                 clock=time.perf_counter):
+        from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+        from mpi_cuda_largescaleknn_tpu.parallel.ring import resolve_engine
+        from mpi_cuda_largescaleknn_tpu.serve.engine import ExecutableCache
+        from mpi_cuda_largescaleknn_tpu.serve.frontend import (
+            routing_cert_slack,
+        )
+
+        if emit not in ("final", "candidates"):
+            raise ValueError(f"emit must be 'final' or 'candidates', "
+                             f"got {emit!r}")
+        self._source = SlabSource(path=path, points=points,
+                                  num_slabs=num_slabs)
+        self.num_slabs = self._source.num_slabs
+        self.n_points = self._source.n_total
+        self.dim = self._source.dim
+        if self.n_points < 1:
+            raise ValueError("streaming engine needs a non-empty index")
+        self.k = int(k)
+        self.id_offset = int(id_offset)
+        self.emit = emit
+        self.max_radius = float(max_radius)
+        self.prefetch_depth = int(prefetch_depth)
+        self.device_slab_budget = int(device_slab_budget)
+        self.host_pool_slabs = int(host_pool_slabs)
+        self._clock = clock
+        #: never retains host rows itself (the pool's tiers do) — the
+        #: /slab_rows pull path needs a single contiguous array, which a
+        #: streaming host by definition does not keep
+        self.host_points = None
+        self.mesh = mesh if mesh is not None else get_mesh(None)
+        #: shared accounting sink: every slab engine counts fetch/result/
+        #: tile totals here, so eviction never zeroes the /stats surface
+        self.timers = PhaseTimers()
+        self._exec_cache = ExecutableCache()
+        self.cert_slack = routing_cert_slack(self.dim)
+        self._meta_lock = threading.Lock()
+        self._engine_name: guarded_by("_meta_lock") = resolve_engine(engine)
+        self._degraded_reason: guarded_by("_meta_lock") = None
+        self._launch_workers: guarded_by("_meta_lock") = 1
+        #: one shape class for every slab engine: pad each engine's local
+        #: shards to the LARGEST slab's per-shard row count, so the shared
+        #: ExecutableCache hits across slabs and re-promotions
+        from mpi_cuda_largescaleknn_tpu.parallel.mesh import AXIS
+
+        num_shards = self.mesh.shape[AXIS]
+        max_slab = max(e - b for b, e in self._source.bounds)
+        self._pad_shard = -(-max_slab // num_shards)
+        self._engine_kw = dict(
+            bucket_size=bucket_size, max_radius=max_radius,
+            max_batch=max_batch, min_batch=min_batch, merge=merge,
+            query_buckets=query_buckets, score_dtype=score_dtype)
+        self._pool = SlabPool(
+            self._source, self._make_engine,
+            device_budget_bytes=device_slab_budget,
+            host_pool_slabs=host_pool_slabs, faults=faults, clock=clock)
+        #: per-slab routing boxes (the in-process PodBoundsTable): f64
+        #: lo/hi per non-empty slab, +inf lower bound for empty ones.
+        #: The scan's rows seed the pool's host tier as they stream by —
+        #: the first promotions then re-read RAM, not the cold source
+        aabbs = self._source.scan_aabbs(sink=self._pool.seed_host)
+        self.slab_aabbs = aabbs
+        self._nonempty = np.array([a["count"] > 0 for a in aabbs], bool)
+        self._slab_lo = np.array([a["lo"] if a["lo"] is not None
+                                  else [np.inf] * self.dim for a in aabbs],
+                                 np.float64).reshape(-1, self.dim)
+        self._slab_hi = np.array([a["hi"] if a["hi"] is not None
+                                  else [-np.inf] * self.dim for a in aabbs],
+                                 np.float64).reshape(-1, self.dim)
+        # bootstrap: promote the first non-empty slab and adopt its
+        # resolved config as the template every sibling shares (all slab
+        # engines are built from the same knobs + shape class)
+        first = int(np.argmax(self._nonempty))
+        t = self._pool.ensure(first, count_stall=False)
+        self._template_slab = first
+        self.max_batch = t.max_batch
+        self.shape_buckets = list(t.shape_buckets)
+        self.query_buckets = dict(t.query_buckets)
+        self.query_buckets_setting = t.query_buckets_setting
+        self.merge_mode = t.merge_mode
+        self.score_dtype = t.score_dtype
+        self.score_mode = t.score_mode
+        self.sort_queries = t.sort_queries
+        self.bucket_size = t.bucket_size
+        self.num_shards = t.num_shards
+        self.slab_device_bytes = int(t.device_bytes)
+        self.canonical_ties = t.canonical_ties
+        #: pod-surface compatibility (a streaming engine is always one
+        #: process; routed hosts wrap it with emit='candidates')
+        self.process_index = 0
+        self.process_count = 1
+
+    # ------------------------------------------------------------ engine mgmt
+
+    def _make_engine(self, slab: int, rows: np.ndarray, row_begin: int):
+        """SlabPool engine factory: one canonical-tie candidates engine
+        per slab, global ids via the slab's row origin, shared timers +
+        AOT cache, common shape class."""
+        from mpi_cuda_largescaleknn_tpu.serve.engine import ResidentKnnEngine
+
+        with self._meta_lock:
+            engine_name = self._engine_name
+            workers = self._launch_workers
+        eng = ResidentKnnEngine(
+            rows, self.k, mesh=self.mesh, engine=engine_name,
+            id_offset=self.id_offset + int(row_begin), emit="candidates",
+            timers=self.timers, executable_cache=self._exec_cache,
+            pad_shard_rows=self._pad_shard, **self._engine_kw)
+        if workers > 1:
+            eng.set_launch_workers(workers)
+        return eng
+
+    @property
+    def slab_pool(self) -> SlabPool:
+        return self._pool
+
+    @property
+    def engine_name(self) -> str:
+        with self._meta_lock:
+            return self._engine_name
+
+    @property
+    def degraded_reason(self) -> str | None:
+        with self._meta_lock:
+            return self._degraded_reason
+
+    def can_degrade(self) -> bool:
+        with self._meta_lock:
+            return self._engine_name == "pallas_tiled"
+
+    def degrade(self, reason: str) -> None:
+        """Swap every resident slab engine (and all future promotions) to
+        the XLA twin — the resident engine's degradation contract, pool
+        wide. Identical results by the twin-engine contract."""
+        with self._meta_lock:
+            if self._engine_name != "pallas_tiled":
+                raise RuntimeError(
+                    f"engine '{self._engine_name}' has no fallback")
+            self._engine_name = "tiled"
+            self._degraded_reason = reason
+        for eng in self._pool.resident_engines():
+            if eng.can_degrade():
+                eng.degrade(reason)
+
+    def set_launch_workers(self, n: int) -> None:
+        with self._meta_lock:
+            self._launch_workers = max(1, int(n))
+            n = self._launch_workers
+        for eng in self._pool.resident_engines():
+            eng.set_launch_workers(n)
+
+    def warmup(self) -> dict:
+        """Compile every shape bucket ONCE (into the shared cache — every
+        slab engine reuses them), then fill the remaining device budget
+        with slabs in row order. Returns the template's warmup dict plus
+        the warm-fill summary."""
+        t = self._pool.ensure(self._template_slab, count_stall=False)
+        info = t.warmup()
+        filled = self._pool.warm_fill(
+            [s for s in range(self.num_slabs)
+             if self._nonempty[s] and s != self._template_slab],
+            self.slab_device_bytes)
+        info["warm_slabs"] = sorted([self._template_slab] + filled)
+        return info
+
+    # ----------------------------------------------------------------- routing
+
+    def _lower_bounds(self, q: np.ndarray) -> np.ndarray:
+        """f64[n, S] squared lower-bound distance per (query, slab); +inf
+        for empty slabs — the PodBoundsTable decision, in-process."""
+        from mpi_cuda_largescaleknn_tpu.utils.math import (
+            aabb_lower_bound_dist2,
+        )
+
+        out = np.full((len(q), self.num_slabs), np.inf)
+        ne = self._nonempty
+        if ne.any() and len(q):
+            out[:, ne] = aabb_lower_bound_dist2(
+                q, self._slab_lo[ne], self._slab_hi[ne])
+        return out
+
+    def _wave1_want(self, q: np.ndarray):
+        """The PR-7 wave-1 routing rule, shared by dispatch and the
+        prefetcher so hints can never warm a different slab set than the
+        dispatch will pin: each query wants its nearest-bounds slab PLUS
+        every slab whose box contains it (a zero lower bound can never
+        certify away). Returns (lb f64[n, S], want bool[n, S])."""
+        lb = self._lower_bounds(q)
+        first = np.argmin(lb, axis=1)
+        reach = np.isfinite(lb[np.arange(len(q)), first])
+        want = lb <= 0.0
+        rows_r = np.nonzero(reach)[0]
+        want[rows_r, first[rows_r]] = True
+        return lb, want
+
+    def prefetch_hint(self, queries) -> None:
+        """Announce a FUTURE batch's rows: compute its wave-1 slab set and
+        enqueue async promotions, so by the time that batch dispatches its
+        slabs are warm (the batcher calls this with the queued rows right
+        after dispatching the current batch — the PR-2 overlap applied to
+        data motion)."""
+        q = np.asarray(queries, np.float32).reshape(-1, self.dim)
+        if len(q) == 0:
+            return
+        _lb, want = self._wave1_want(q)
+        self.timers.count("prefetch_hints", 1)
+        self._pool.prefetch(np.nonzero(want.any(axis=0))[0].tolist())
+
+    # --------------------------------------------------------------- query API
+
+    def dispatch(self, queries: np.ndarray) -> _StreamHandle:
+        """Wave 1 of the streamed batch: route rows to their
+        nearest-bounds slab plus every slab whose box contains them (the
+        PR-7 rule — a zero lower bound can never certify away), PIN that
+        slab set, promote any non-resident member (a stall, counted), and
+        launch the per-slab sub-batches on the slab engines' async launch
+        pools. Also enqueues prefetch for the next-nearest
+        ``prefetch_depth`` slabs — the likely escalation targets — so an
+        escalation wave finds them warm."""
+        queries = np.ascontiguousarray(
+            np.asarray(queries, np.float32).reshape(-1, self.dim))
+        n = len(queries)
+        handle = _StreamHandle(queries, n, self.engine_name, self._clock())
+        if n == 0:
+            return handle
+        lb, want = self._wave1_want(queries)
+        visited = np.zeros((n, self.num_slabs), bool)
+        wave = [(s, np.nonzero(want[:, s])[0])
+                for s in range(self.num_slabs) if want[:, s].any()]
+        sids = [s for s, _rows in wave]
+        self._pool.pin(sids)
+        handle.pinned.update(sids)
+        # hand the whole wave to the promotion thread first: a multi-slab
+        # cold wave then builds one slab on this thread while the next
+        # builds asynchronously, instead of strictly serial stalls
+        self._pool.prefetch(sids)
+        try:
+            for s, rows in wave:
+                eng = self._pool.ensure(s)
+                handle.subs.append((s, rows, eng,
+                                    eng.dispatch(queries[rows])))
+                visited[rows, s] = True
+        except BaseException:
+            # a failed promotion/dispatch must not leak this batch's pins
+            # — leaked pins would make the slabs permanently unevictable
+            self._pool.unpin(handle.pinned)
+            handle.pinned = set()
+            raise
+        handle.lb, handle.visited = lb, visited
+        if self.prefetch_depth > 0:
+            # escalation insurance: the unvisited slabs nearest ANY row of
+            # this batch are the ones its escalation waves would stall on
+            rest = np.where(want.any(axis=0), np.inf, lb.min(axis=0))
+            order = np.argsort(rest, kind="stable")
+            depth = [int(s) for s in order[:self.prefetch_depth]
+                     if np.isfinite(rest[s])]
+            if depth:
+                self._pool.prefetch(depth)
+        return handle
+
+    def _complete_fold(self, handle: _StreamHandle):
+        """Fold wave partials; escalate uncertified (query, slab) pairs
+        until certification closes — the RoutedPodFanout loop, in-process
+        and loss-free (every slab is always reachable: a miss stalls, it
+        never drains). Returns the folded (d2[n, k], idx[n, k])."""
+        from mpi_cuda_largescaleknn_tpu.serve.frontend import fold_candidates
+
+        n, k = handle.n, self.k
+        cur_d2 = np.full((n, k), np.inf, np.float32)
+        cur_idx = np.full((n, k), -1, np.int32)
+        q, lb, visited = handle.queries, handle.lb, handle.visited
+        lb_safe = lb * (1.0 - self.cert_slack)
+        reachable = np.isfinite(lb_safe)
+        subs = handle.subs
+        try:
+            wave = 1
+            while True:
+                for s, rows, eng, sub in subs:
+                    d2p, idxp = eng.complete_candidates(sub)
+                    fold_candidates(cur_d2, cur_idx, rows, d2p, idxp, k)
+                r2 = cur_d2[:, k - 1].astype(np.float64)
+                need = (~visited) & reachable & (lb_safe <= r2[:, None])
+                if not need.any():
+                    break
+                if wave == 1:
+                    self.timers.count("stream_escalations",
+                                      int(need.any(axis=1).sum()))
+                self.timers.count("stream_escalation_waves", 1)
+                wave += 1
+                sids = [s for s in range(self.num_slabs) if need[:, s].any()]
+                new = [s for s in sids if s not in handle.pinned]
+                if new:
+                    self._pool.pin(new)
+                    handle.pinned.update(new)
+                    self._pool.prefetch(new)  # overlap multi-slab waves
+                subs = []
+                for s in sids:
+                    rows = np.nonzero(need[:, s])[0]
+                    eng = self._pool.ensure(s)
+                    subs.append((s, rows, eng, eng.dispatch(q[rows])))
+                    visited[rows, s] = True
+        finally:
+            self._pool.unpin(handle.pinned)
+            handle.pinned = set()
+        self.timers.hist("stream_batch_seconds").record(
+            self._clock() - handle.t0)
+        self.timers.count("stream_batches", 1)
+        return cur_d2, cur_idx
+
+    def complete(self, handle: _StreamHandle):
+        """(dists f32[n], idx i32[n, k]) — the public engine contract,
+        bit-identical to a fully-resident engine of the same knobs (the
+        canonical fold over canonical-tie slab partials; the routed-pod
+        parity argument with slabs instead of hosts)."""
+        if handle.n == 0:
+            return (np.zeros(0, np.float32),
+                    np.zeros((0, self.k), np.int32))
+        if self.emit == "candidates":
+            raise RuntimeError(
+                "emit='candidates' streaming engines return full candidate"
+                " rows — use complete_candidates (the routed host's fold)")
+        d2, idx = self._complete_fold(handle)
+        return np.sqrt(d2[:, self.k - 1]), idx
+
+    def complete_candidates(self, handle: _StreamHandle):
+        """Routed-host streaming ``complete``: the folded full candidate
+        rows (dist2[n, k], idx[n, k]) over this engine's slabs — what
+        POST /route_knn serves when a routed host streams sub-slabs."""
+        if handle.n == 0:
+            return (np.full((0, self.k), np.inf, np.float32),
+                    np.full((0, self.k), -1, np.int32))
+        if self.emit != "candidates":
+            raise RuntimeError(
+                "engine was built with emit='final' — construct with "
+                "emit='candidates' for the routed candidate-row contract")
+        return self._complete_fold(handle)
+
+    def query(self, queries: np.ndarray):
+        return self.complete(self.dispatch(queries))
+
+    def close(self) -> None:
+        self._pool.close()
+
+    # ------------------------------------------------------------------ stats
+
+    def stats(self) -> dict:
+        pool = self._pool.stats()
+        cache = self._exec_cache.stats()
+        with self._meta_lock:
+            engine_name = self._engine_name
+            degraded_reason = self._degraded_reason
+        return {
+            "engine": engine_name,
+            "merge": self.merge_mode,
+            "score_dtype": self.score_dtype,
+            "score_mode": self.score_mode,
+            "dim": self.dim,
+            "degraded_reason": degraded_reason,
+            "n_points": self.n_points,
+            "k": self.k,
+            "num_shards": self.num_shards,
+            "multihost": False,
+            "process_index": self.process_index,
+            "process_count": self.process_count,
+            "my_positions": list(range(self.num_shards)),
+            "row_offset": self.id_offset,
+            "emit": self.emit,
+            "canonical_ties": self.canonical_ties,
+            "max_radius": (None if math.isinf(self.max_radius)
+                           else self.max_radius),
+            # the routing surface a pod front end folds over: one box per
+            # SLAB (the streaming engine's own routing granularity)
+            "shard_bounds": self.slab_aabbs,
+            "device_bytes": self.slab_device_bytes * pool["device_resident"],
+            "max_batch": self.max_batch,
+            "bucket_size": self.bucket_size,
+            "shape_buckets": list(self.shape_buckets),
+            # AOT discipline pool-wide: the shared cache's compile count is
+            # the recompile-freedom number (flat across slab churn)
+            "compiled_shapes": cache["shapes"],
+            "compile_count": cache["compiles"],
+            "executable_cache": cache,
+            "query_buckets": {str(qv): b for qv, b in
+                              sorted(self.query_buckets.items())},
+            "sort_queries": self.sort_queries,
+            "tiles_executed": self.timers.counter("tiles_executed"),
+            "tiles_skipped": self.timers.counter("tiles_skipped"),
+            "tiles_executed_mxu": self.timers.counter("tiles_executed_mxu"),
+            "tiles_skipped_mxu": self.timers.counter("tiles_skipped_mxu"),
+            "tiles_executed_vpu": self.timers.counter("tiles_executed_vpu"),
+            "tiles_skipped_vpu": self.timers.counter("tiles_skipped_vpu"),
+            "fetch_bytes": self.timers.counter("fetch_bytes"),
+            "result_rows": self.timers.counter("result_rows"),
+            # the tiered-index surface: per-tier residency, budget, hit/
+            # miss counters, promotion/eviction totals, stall accounting
+            "slab_pool": dict(
+                pool,
+                slab_device_bytes=self.slab_device_bytes,
+                prefetch_depth=self.prefetch_depth,
+                prefetch_hints=self.timers.counter("prefetch_hints")),
+            "streaming": {
+                "num_slabs": self.num_slabs,
+                "batches": self.timers.counter("stream_batches"),
+                "escalations": self.timers.counter("stream_escalations"),
+                "escalation_waves":
+                    self.timers.counter("stream_escalation_waves"),
+            },
+            "timers": self.timers.report(),
+        }

@@ -533,6 +533,7 @@ class TestRepoGate:
             "serve/health.py": {"HostHealth", "HealthMonitor"},
             "serve/replica.py": {"ReplicaSet", "ReplicaManager"},
             "serve/server.py": {"ServingMetrics"},
+            "serve/slabpool.py": {"SlabPool", "StreamingKnnEngine"},
         }
         for rel, expected in want.items():
             path = os.path.join(base, "mpi_cuda_largescaleknn_tpu", rel)

@@ -49,9 +49,14 @@ if [ "${SERVE_BENCH:-1}" != "0" ] && [ "$rc" -ne 124 ]; then
   # availability >= 0.999, and the post-handoff probe being bitwise
   # identical to the never-failed answers (the adopted slab proves
   # itself); q/s at R=2 vs R=1 is the trajectory number
+  # --streaming-bench adds the tiered-slab section (streaming_compare):
+  # the sweep workload churning a slab pool at index size 4x the device
+  # budget — gated on bitwise probe parity vs a fully-resident engine
+  # (cold AND post-churn) plus a stream-stall-fraction ceiling (the
+  # bounds-driven prefetcher must hide promotions under compute)
   timeout -k 10 2400 python tools/serve_smoke.py --duration 2 --trials 3 \
       --locality-bench --multihost-bench --kernel-bench --routing-bench \
-      --chaos-bench --replica-bench \
+      --chaos-bench --replica-bench --streaming-bench \
       --out BENCH_serve.json >/dev/null || { brc=$?; [ "$rc" -eq 0 ] && rc=$brc; }
 fi
 # the lskcheck gate blocks even when the tests pass (and never masks a

@@ -16,7 +16,11 @@ Workloads (``--workload``): ``uniform`` draws every query independently in
 and each REQUEST samples one blob with ``--blob-sigma`` spread: the
 one-user-one-region pattern the serving engine's Morton-sorted multi-bucket
 traversal exists to exploit (``serve_smoke.py --locality-bench`` drives
-both and compares tile counts).
+both and compares tile counts). ``sweep`` drifts a blob window along the
+box diagonal over ``--sweep-period`` seconds: the hot region MOVES, so a
+tiered slab index (serve/slabpool.py) churns through real
+eviction/readmission cycles — clustered/uniform never evict once warm
+(``serve_smoke.py --streaming-bench`` drives it).
 
     python tools/loadgen.py --url http://127.0.0.1:8080 --duration 10 \
         --concurrency 8 --batch 16 [--qps 500] [--workload clustered] \
@@ -190,6 +194,7 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
              scale: float = 1.0, server_stats: bool = False,
              binary: bool = False, workload: str = "uniform",
              blobs: int = 16, blob_sigma: float = 0.02,
+             sweep_period_s: float = 2.0,
              hosts: list[str] | None = None,
              retry_after_cap_s: float = 1.0) -> dict:
     """Drive the server; returns the JSON-able report (also the test API).
@@ -223,8 +228,16 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
     bench must not park its workers past the measurement window, while a
     patient production client can raise it to the server's real drain
     horizon.
+
+    ``workload="sweep"`` drives a WINDOW of blob centers drifting along
+    the index box's main diagonal over ``sweep_period_s`` (wrapping):
+    each request samples a blob around the current window position, so
+    the hot slab set MOVES through the index — the churn pattern that
+    forces a tiered slab pool (serve/slabpool.py) through real
+    eviction/readmission cycles, where clustered/uniform streams never
+    evict again once warm.
     """
-    if workload not in ("uniform", "clustered"):
+    if workload not in ("uniform", "clustered", "sweep"):
         raise ValueError(f"unknown workload '{workload}'")
     endpoints = list(hosts) if hosts else [url]
     # blob centers are seed-deterministic and shared by all workers; each
@@ -232,6 +245,7 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
     # Query draws use a PER-WORKER Generator (numpy Generators are not
     # thread-safe — concurrent draws from a shared one can corrupt state)
     centers = np.random.default_rng(seed).random((max(1, blobs), 3)) * scale
+    t_start = time.monotonic()
     hist = LatencyHistogram()
     ep_hists = {u: LatencyHistogram() for u in endpoints}
     lock = threading.Lock()
@@ -274,6 +288,14 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
         caller should honor, or None."""
         if workload == "clustered":
             c = centers[rng.integers(len(centers))]
+            q = np.clip(c + rng.normal(0.0, blob_sigma * scale, (batch, 3)),
+                        0.0, scale).astype(np.float32)
+        elif workload == "sweep":
+            # drifting window: position along the box diagonal is a pure
+            # function of elapsed time, so the hot slab set moves through
+            # the index at a controlled rate (eviction/readmission churn)
+            frac = ((time.monotonic() - t_start) / sweep_period_s) % 1.0
+            c = np.full(3, frac * scale)
             q = np.clip(c + rng.normal(0.0, blob_sigma * scale, (batch, 3)),
                         0.0, scale).astype(np.float32)
         else:
@@ -402,6 +424,8 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
         "workload": workload,
         **({"blobs": blobs, "blob_sigma": blob_sigma}
            if workload == "clustered" else {}),
+        **({"blob_sigma": blob_sigma, "sweep_period_s": sweep_period_s}
+           if workload == "sweep" else {}),
         "url": url, "duration_s": round(elapsed, 3),
         "concurrency": concurrency, "batch": batch, "binary": binary,
         "offered_qps": qps if qps > 0 else None,
@@ -449,16 +473,21 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--scale", type=float, default=1.0,
                     help="query box [0, scale)^3 (match the index bbox)")
-    ap.add_argument("--workload", choices=("uniform", "clustered"),
+    ap.add_argument("--workload", choices=("uniform", "clustered", "sweep"),
                     default="uniform",
                     help="uniform: every query independent in the box; "
                          "clustered: each request samples one Gaussian "
-                         "blob (query locality)")
+                         "blob (query locality); sweep: a blob window "
+                         "drifting along the box diagonal (tiered-slab "
+                         "eviction/readmission churn)")
     ap.add_argument("--blobs", type=int, default=16,
                     help="clustered: number of blob centers in the box")
     ap.add_argument("--blob-sigma", type=float, default=0.02,
-                    help="clustered: per-axis blob sigma as a fraction "
-                         "of --scale")
+                    help="clustered/sweep: per-axis blob sigma as a "
+                         "fraction of --scale")
+    ap.add_argument("--sweep-period", type=float, default=2.0,
+                    help="sweep: seconds per full diagonal traversal "
+                         "(wrapping)")
     ap.add_argument("--retry-after-cap", type=float, default=1.0,
                     help="max seconds a closed-loop worker honors a "
                          "Retry-After on 503/429 (default 1.0; raise for "
@@ -474,7 +503,8 @@ def main(argv=None) -> int:
                       timeout_s=a.timeout, seed=a.seed, scale=a.scale,
                       server_stats=a.server_stats, binary=a.binary,
                       workload=a.workload, blobs=a.blobs,
-                      blob_sigma=a.blob_sigma, hosts=hosts,
+                      blob_sigma=a.blob_sigma,
+                      sweep_period_s=a.sweep_period, hosts=hosts,
                       retry_after_cap_s=a.retry_after_cap)
     text = json.dumps(report, indent=2)
     print(text)

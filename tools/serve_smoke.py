@@ -16,7 +16,11 @@ oracle-exact (``routing_compare``), plus (``--replica-bench``) the
 replication/handoff drill — a rolling single-host kill across an R=2
 routed pod with a warm standby, gated on ZERO ``exact: false``
 responses, availability >= 0.999, and post-handoff bitwise probe parity
-(``replica_compare``; tools/ci_tier1.sh passes all flags).
+(``replica_compare``), plus (``--streaming-bench``) the tiered-slab
+streaming drill — the sweep workload churning a slab pool at index size
+4x the device budget, gated on BITWISE probe parity vs a fully-resident
+engine (cold and post-churn) and a stream-stall-fraction ceiling
+(``streaming_compare``; tools/ci_tier1.sh passes all flags).
 
 Boots the full serving stack in-process on a CPU fixture (default: one
 virtual device, single-threaded Eigen, tiled engine — one core per
@@ -112,7 +116,8 @@ def _pod_env() -> dict:
 
 
 def _run_loadgen(base_url, *, duration_s, concurrency, batch, seed,
-                 workload="uniform", blobs=8, blob_sigma=0.02) -> dict:
+                 workload="uniform", blobs=8, blob_sigma=0.02,
+                 sweep_period=None) -> dict:
     """Drive tools/loadgen.py as a SUBPROCESS: the client's request work
     must not share this interpreter's GIL with the server's handler,
     batcher, and merge threads, or the measurement throttles the thing it
@@ -129,7 +134,10 @@ def _run_loadgen(base_url, *, duration_s, concurrency, batch, seed,
              "--duration", str(duration_s), "--concurrency", str(concurrency),
              "--batch", str(batch), "--seed", str(seed), "--server-stats",
              "--binary", "--workload", workload, "--blobs", str(blobs),
-             "--blob-sigma", str(blob_sigma), "--out", out_path],
+             "--blob-sigma", str(blob_sigma)]
+            + (["--sweep-period", str(sweep_period)]
+               if sweep_period else [])
+            + ["--out", out_path],
             check=True, stdout=subprocess.DEVNULL, timeout=duration_s + 120)
         with open(out_path) as f:
             return json.load(f)
@@ -407,6 +415,137 @@ def run_locality_bench(*, n_points=8192, k=16, duration_s=2.0,
             out[f"qps_ratio_{wl}"] = round(
                 auto[wl]["qps"] / b1[wl]["qps"], 3)
     return out
+
+
+def run_streaming_bench(*, n_points=16384, k=16, num_slabs=8,
+                        budget_slabs=2, duration_s=2.0, concurrency=4,
+                        batch=16, max_batch=128, max_delay_s=0.008,
+                        trials=2, seed=0,
+                        stall_fraction_ceiling=0.5) -> dict:
+    """Tiered slab index (serve/slabpool.py) at index size
+    ``num_slabs / budget_slabs`` x the device budget (the default 8/2 =
+    4x), driven by the loadgen ``sweep`` workload so the hot slab set
+    drifts through the index — real eviction/readmission churn, the case
+    clustered/uniform never produce once warm.
+
+    Two gates ride the exit code (``streaming_compare`` in
+    BENCH_serve.json): (1) a fixed probe batch served through the
+    streaming engine must be BITWISE identical (dists AND neighbor ids)
+    to a fully-resident ResidentKnnEngine of the same knobs, and (2) the
+    stream-stall fraction — stall seconds per wall second of load — must
+    stay under ``stall_fraction_ceiling``: the bounds-driven prefetcher
+    (dispatch's next-nearest promotions + the batcher's batch-ahead
+    hints) must hide most promotions under compute, or streaming is just
+    a slow resident engine. Points are Morton-sorted so row slabs are
+    spatially tight (the io partitioner's order — the same requirement
+    routed serving documents); q/s is trajectory data."""
+    _setup_cpu_fixture(1)
+    from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+    from mpi_cuda_largescaleknn_tpu.serve.engine import ResidentKnnEngine
+    from mpi_cuda_largescaleknn_tpu.serve.server import build_server
+    from mpi_cuda_largescaleknn_tpu.serve.slabpool import StreamingKnnEngine
+    from mpi_cuda_largescaleknn_tpu.utils.math import morton_argsort
+
+    rng = np.random.default_rng(seed)
+    points = rng.random((n_points, 3)).astype(np.float32)
+    points = points[morton_argsort(points, points.min(axis=0),
+                                   points.max(axis=0))]
+    mesh = get_mesh(1)
+    kw = dict(engine="tiled", bucket_size=64, max_batch=max_batch,
+              min_batch=16)
+    eng = StreamingKnnEngine(points=points, num_slabs=num_slabs, k=k,
+                             mesh=mesh, prefetch_depth=2, **kw)
+    # budget in BYTES against the engines' reported per-slab footprint
+    # (all slabs share one shape class, so one number covers them)
+    budget = eng.slab_device_bytes * budget_slabs
+    eng.slab_pool.set_device_budget(budget)
+    eng.warmup()
+    index_bytes = eng.slab_device_bytes * num_slabs
+    srv = build_server(eng, port=0, max_delay_s=max_delay_s,
+                       pipeline_depth=2)
+    srv.ready = True
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        # bitwise parity probe BEFORE the churn (cold-ish pool) ...
+        probe = np.random.default_rng(seed + 7).random((64, 3)).astype(
+            np.float32)
+        got = _post_probe(base, probe)
+        ref = ResidentKnnEngine(points, k, mesh=mesh, **kw)
+        want_d, want_n = ref.query(probe)
+        parity_cold = (np.array_equal(got[0], np.asarray(want_d,
+                                                         np.float32))
+                       and np.array_equal(got[1], np.asarray(want_n)))
+        reps = []
+        for trial in range(trials):
+            before = eng.slab_pool.stats()
+            t0 = time.perf_counter()
+            rep = _run_loadgen(base, duration_s=duration_s,
+                               concurrency=concurrency, batch=batch,
+                               seed=seed + trial, workload="sweep",
+                               blob_sigma=0.05,
+                               sweep_period=max(1.0, duration_s / 2))
+            wall = time.perf_counter() - t0
+            after = eng.slab_pool.stats()
+            rep["wall_s"] = round(wall, 3)
+            for c in ("promotions", "evictions", "stream_stalls",
+                      "stream_stall_seconds", "device_hits", "host_hits",
+                      "cold_reads"):
+                rep[c] = round(after[c] - before[c], 6)
+            rep["stall_fraction"] = round(
+                rep["stream_stall_seconds"] / max(wall, 1e-9), 4)
+            reps.append(rep)
+        # ... and AFTER it (the pool has churned through the whole index)
+        got2 = _post_probe(base, probe)
+        parity_hot = (np.array_equal(got2[0], np.asarray(want_d,
+                                                         np.float32))
+                      and np.array_equal(got2[1], np.asarray(want_n)))
+        oracle = _probe_oracle_exact(base, points, k, seed)
+    finally:
+        srv.close()
+        eng.close()
+    med = sorted(reps, key=lambda r: r["qps"])[len(reps) // 2]
+    stall_fraction = max(r["stall_fraction"] for r in reps)
+    pool = eng.slab_pool.stats()
+    return {
+        "kind": "serve_streaming_bench", "n_points": n_points, "k": k,
+        "num_slabs": num_slabs, "budget_slabs": budget_slabs,
+        "device_budget_bytes": budget, "index_device_bytes": index_bytes,
+        "index_over_budget_ratio": round(index_bytes / budget, 2),
+        "duration_s": duration_s, "concurrency": concurrency,
+        "batch": batch, "trials": trials, "workload": "sweep",
+        "qps": med["qps"], "p99_ms": med["p99_ms"],
+        "qps_trials": [r["qps"] for r in reps],
+        "stall_fraction": stall_fraction,
+        "stall_fraction_trials": [r["stall_fraction"] for r in reps],
+        "stall_fraction_ceiling": stall_fraction_ceiling,
+        "stall_ok": stall_fraction <= stall_fraction_ceiling,
+        "promotions": sum(r["promotions"] for r in reps),
+        "evictions": sum(r["evictions"] for r in reps),
+        "cold_reads": sum(r["cold_reads"] for r in reps),
+        "host_hits": sum(r["host_hits"] for r in reps),
+        "pool_final": pool,
+        "bitwise_parity_vs_resident": bool(parity_cold and parity_hot),
+        "bitwise_parity_cold": bool(parity_cold),
+        "bitwise_parity_hot": bool(parity_hot),
+        "oracle_exact": bool(oracle),
+    }
+
+
+def _post_probe(base_url, q):
+    """POST a probe batch (JSON, neighbors on) -> (dists f32[n],
+    neighbors i32[n, k]). f32 distances survive the JSON float64
+    round-trip exactly (every f32 is representable), so the comparison
+    upstream is genuinely bitwise."""
+    body = json.dumps({"queries": np.asarray(q).tolist(),
+                       "neighbors": True}).encode()
+    req = urllib.request.Request(
+        base_url + "/knn", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        obj = json.loads(resp.read())
+    return (np.asarray(obj["dists"], np.float32),
+            np.asarray(obj["neighbors"], np.int32))
 
 
 def run_multihost_bench(*, n_points=8192, k=16, hosts=2, duration_s=2.0,
@@ -1283,6 +1422,16 @@ def main(argv=None) -> int:
                     help="internal: run ONLY the replica bench in this "
                          "process (1-device fixture, boots its own pod + "
                          "standby) and print its JSON")
+    ap.add_argument("--streaming-bench", action="store_true",
+                    help="also run the tiered-slab streaming bench "
+                         "(sweep-workload churn at index size 4x the "
+                         "device budget, bitwise probe parity vs a "
+                         "fully-resident engine + stream-stall-fraction "
+                         "ceiling) in a subprocess and embed "
+                         "streaming_compare")
+    ap.add_argument("--streaming-child", action="store_true",
+                    help="internal: run ONLY the streaming bench in this "
+                         "process (1-device fixture) and print its JSON")
     ap.add_argument("--kernel-bench", action="store_true",
                     help="also run the distance-kernel bench (elementwise "
                          "VPU vs MXU matmul-form at D in {3, 8, 64}) in a "
@@ -1312,6 +1461,18 @@ def main(argv=None) -> int:
                      and report.get("availability_ok")
                      and report.get("bitwise_parity_after_handoff")) \
             else 1
+
+    if a.streaming_child:
+        # the streaming bench pins its OWN fixture shape (16k points, 8
+        # slabs, 2-slab device budget = 4x over-budget); only the timing
+        # knobs ride through
+        report = run_streaming_bench(
+            duration_s=a.duration, concurrency=a.concurrency,
+            batch=min(a.batch, 16), trials=max(1, a.trials - 1),
+            max_delay_s=a.max_delay_ms / 1e3, seed=a.seed)
+        print(json.dumps(report, indent=2))
+        return 0 if (report.get("bitwise_parity_vs_resident")
+                     and report.get("stall_ok")) else 1
 
     if a.kernel_child:
         report = run_kernel_bench(n_points=a.points, k=a.k, seed=a.seed)
@@ -1464,6 +1625,38 @@ def main(argv=None) -> int:
                 detail = (raw.decode(errors="replace")
                           if isinstance(raw, bytes) else str(raw))[-1500:]
             report["kernel_compare"] = {
+                "error": f"{str(e)[:300]} :: {detail}"}
+    if a.streaming_bench:
+        # same subprocess discipline: the streaming child pins the
+        # 1-device single-thread fixture. BOTH streaming gates ride the
+        # exit code: bitwise probe parity vs a fully-resident engine
+        # (cold AND after the sweep churn) and the stream-stall-fraction
+        # ceiling — the prefetcher must hide promotions under compute;
+        # q/s and the churn counters are the trajectory numbers
+        try:
+            child = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--streaming-child",
+                 "--duration", str(a.duration),
+                 "--concurrency", str(a.concurrency),
+                 "--batch", str(a.batch), "--trials", str(a.trials),
+                 "--max-delay-ms", str(a.max_delay_ms),
+                 "--seed", str(a.seed)],
+                capture_output=True, text=True, env=env,
+                timeout=300 + a.duration * (a.trials + 2) * 6)
+            sc = json.loads(child.stdout)
+            report["streaming_compare"] = sc
+            if "error" not in sc:  # infra hiccups degrade, never gate
+                ok = (ok and bool(sc.get("bitwise_parity_vs_resident"))
+                      and bool(sc.get("stall_ok")))
+        except (subprocess.TimeoutExpired, json.JSONDecodeError) as e:
+            if isinstance(e, json.JSONDecodeError):
+                detail = (child.stderr or child.stdout or "")[-1500:]
+            else:
+                raw = e.stderr or e.stdout or b""
+                detail = (raw.decode(errors="replace")
+                          if isinstance(raw, bytes) else str(raw))[-1500:]
+            report["streaming_compare"] = {
                 "error": f"{str(e)[:300]} :: {detail}"}
     if a.multihost_bench:
         # same subprocess discipline: the multi-host child pins a 2-device
