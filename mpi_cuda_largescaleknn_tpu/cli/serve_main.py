@@ -72,6 +72,14 @@ SERVE_FLAGS = """
                     previous batch's host merge; 1 = fully serialized)
   --max-queue-rows N  admission cap on queued+running rows (default 4096)
   --timeout-ms F    default per-request deadline (default 5000)
+  --recall-policy PATH  recall-SLO plan table (JSON from
+                    tools/recall_harness.py) replacing the built-in
+                    calibrated defaults; requests carrying
+                    ``"recall": 0.95`` (or ``?recall=`` for binary) are
+                    served by the cheapest plan whose measured recall
+                    meets the target, flagged ``exact: false``
+                    (serve/recall.py; docs/SERVING.md "Recall-SLO tier").
+                    Exact stays the default for requests with no target
   --seq-timeout-s F how long a pod host waits for its turn in the
                     /shard_knn sequence order before answering 503 +
                     Retry-After (default 120; replicate mode only — a
@@ -136,6 +144,7 @@ def parse_serve_args(argv: list[str]) -> dict:
            "host_pool_slabs": 0, "prefetch_depth": 1,
            "max_delay_ms": 2.0, "pipeline_depth": 2,
            "max_queue_rows": 4096, "seq_timeout_s": None,
+           "recall_policy": None,
            "timeout_ms": 5000.0, "warmup": True, "timings": False,
            "verbose": False,
            "coordinator": None, "num_hosts": 1, "host_id": 0,
@@ -188,6 +197,8 @@ def parse_serve_args(argv: list[str]) -> dict:
                 i += 1; opt["timeout_ms"] = float(argv[i])
             elif arg == "--seq-timeout-s":
                 i += 1; opt["seq_timeout_s"] = float(argv[i])
+            elif arg == "--recall-policy":
+                i += 1; opt["recall_policy"] = argv[i]
             elif arg == "--coordinator":
                 i += 1; opt["coordinator"] = argv[i]
             elif arg == "--num-hosts":
@@ -418,13 +429,22 @@ def main(argv: list[str] | None = None) -> int:
             if opt["timings"]:
                 sys.stderr.write(engine.timers.dump() + "\n")
         return 0
+    recall_policy = None
+    if opt["recall_policy"]:
+        from mpi_cuda_largescaleknn_tpu.serve.recall import RecallPolicy
+
+        recall_policy = RecallPolicy.from_file(opt["recall_policy"])
+        print(f"recall policy from {opt['recall_policy']}: "
+              + ", ".join(f"{p.name} (est {p.recall_estimated:g})"
+                          for p in recall_policy.plans))
     server = build_server(
         engine, host=opt["host"], port=opt["port"],
         max_delay_s=opt["max_delay_ms"] / 1e3,
         pipeline_depth=opt["pipeline_depth"],
         max_queue_rows=opt["max_queue_rows"],
         default_timeout_s=opt["timeout_ms"] / 1e3,
-        verbose=opt["verbose"])
+        verbose=opt["verbose"],
+        recall_policy=recall_policy)
     try:
         serve_forever(server, warmup=opt["warmup"])
     except KeyboardInterrupt:

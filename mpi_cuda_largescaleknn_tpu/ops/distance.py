@@ -175,7 +175,7 @@ def mxu_scores(q: jnp.ndarray, p: jnp.ndarray,
 
 def score_tile(q: jnp.ndarray, p: jnp.ndarray, pid: jnp.ndarray, k: int, *,
                score_dtype: str = "f32", mask: jnp.ndarray | None = None,
-               pn2: jnp.ndarray | None = None):
+               pn2: jnp.ndarray | None = None, skip_rescore: bool = False):
     """Score one distance tile, ready for ``merge_candidates``.
 
     Args:
@@ -185,6 +185,14 @@ def score_tile(q: jnp.ndarray, p: jnp.ndarray, pid: jnp.ndarray, k: int, *,
         [..., Q, T]; False lanes can never be adopted (their distances are
         forced to +inf — in BOTH modes, including after the rescore).
       pn2: optional precomputed f32[..., T] squared point norms (bf16 mode).
+      skip_rescore: approximate one-pass mode (the recall-SLO tier's knob,
+        serve/recall.py): under bf16 at D >= ``mxu_min_dim`` the raw
+        matmul-form scores are fed straight to the merge — no survivor
+        top_k, no exact rescore — trading the bf16x3 error bound
+        (~scale * 2^-16) for the cost of the selection machinery. Scores
+        are clamped at 0 (the expansion can cancel slightly negative).
+        Below the MXU threshold the elementwise path is exact AND fastest,
+        so the knob is a no-op there by design.
 
     Returns ``(cand_d2, cand_idx)``:
 
@@ -200,7 +208,8 @@ def score_tile(q: jnp.ndarray, p: jnp.ndarray, pid: jnp.ndarray, k: int, *,
     validate_score_dtype(score_dtype)
     t = p.shape[-2]
     w = rescore_width(k, t)
-    if score_dtype == "f32" or q.shape[-1] < mxu_min_dim() or w >= t:
+    if (score_dtype == "f32" or q.shape[-1] < mxu_min_dim()
+            or (w >= t and not skip_rescore)):
         # exact full-width tile (also the bf16 fallback below the MXU
         # dimensionality threshold, and when the survivor window would
         # cover every lane anyway — then the top_k buys nothing)
@@ -213,6 +222,12 @@ def score_tile(q: jnp.ndarray, p: jnp.ndarray, pid: jnp.ndarray, k: int, *,
     scores = mxu_scores(q, p, pn2=pn2)
     if mask is not None:
         scores = jnp.where(mask, scores, jnp.inf)
+    if skip_rescore:
+        # one-pass approximate tile: full width, raw expansion scores
+        # (masked lanes stay +inf; the 0-clamp keeps sqrt() downstream
+        # finite when cancellation dips a self-pair slightly negative)
+        idx = jnp.broadcast_to(pid[..., None, :], scores.shape)
+        return jnp.maximum(scores, jnp.float32(0.0)), idx
     _neg, pos = jax.lax.top_k(-scores, w)               # [..., Q, W]
     # restore lane order: the survivors must reach the merge as a
     # subsequence of the tile's original lanes, or equal-distance

@@ -146,7 +146,10 @@ def knn_update_tiled(state: CandidateState, q: BucketedPoints,
                      skip_self=None, self_group: int = 1,
                      canonical_ties: bool = False,
                      score_dtype: str = "f32",
-                     point_norms2=None):
+                     point_norms2=None,
+                     prune_shrink: float = 1.0,
+                     visit_frac: float = 1.0,
+                     skip_rescore: bool = False):
     """Fold every real point of ``p`` into the candidate state (one
     reference ``runQuery`` launch, at bucket granularity).
 
@@ -197,8 +200,28 @@ def knn_update_tiled(state: CandidateState, q: BucketedPoints,
     order must admit. (With the default fold-arrival discipline the same
     bucket is safely skippable — a tie never displaces — which is why the
     default keeps ``<``: identical results, strictly fewer visits.)
+
+    ``prune_shrink`` / ``visit_frac`` / ``skip_rescore`` are the recall-SLO
+    tier's APPROXIMATE truncation knobs (serve/recall.py), all trace-time
+    statics so each plan is its own AOT program. ``prune_shrink < 1.0``
+    tightens the kth-distance early exit: a bucket is visited only while
+    its box distance is within ``prune_shrink`` of the query bucket's worst
+    kth radius, so border buckets that could at best shave the candidate
+    tail are skipped. ``visit_frac < 1.0`` hard-caps the nearest-first
+    schedule at that fraction of its visit steps — the nearest buckets
+    (where the mass of true neighbors lives) are always walked first, so
+    the cap converts the schedule's tail into recall loss rather than
+    uniform loss. ``skip_rescore`` forwards to ``score_tile`` (one-pass
+    bf16, no exact rescore). At the defaults (1.0, 1.0, False) the traced
+    program is IDENTICAL to the exact engine's — the exact path stays
+    bitwise-stable by construction.
     """
     validate_score_dtype(score_dtype)
+    if not 0.0 < prune_shrink <= 1.0:
+        raise ValueError(f"prune_shrink must be in (0, 1], "
+                         f"got {prune_shrink}")
+    if not 0.0 < visit_frac <= 1.0:
+        raise ValueError(f"visit_frac must be in (0, 1], got {visit_frac}")
     num_qb, s_q = q.ids.shape
     num_pb, s_p = p.ids.shape
     dim = q.pts.shape[-1]
@@ -244,14 +267,23 @@ def knn_update_tiled(state: CandidateState, q: BucketedPoints,
         # canonical mode must VISIT buckets tied exactly at the prune radius
         # (they can hold equal-distance candidates the (d2, id) order
         # admits); the default's strict < skips them — a tie never
-        # displaces under fold-arrival order, so skipping is free there
+        # displaces under fold-arrival order, so skipping is free there.
+        # The approximate tier shrinks the radius at trace time; the
+        # branch keeps the exact (shrink=1) jaxpr byte-identical
+        if prune_shrink < 1.0:
+            radius2 = radius2 * jnp.float32(prune_shrink)
         return box_d2 <= radius2 if canonical_ties else box_d2 < radius2
+
+    # approximate visit cap: walk at most this many nearest-first steps
+    # (>= 1 so every query always folds its nearest point buckets)
+    n_steps_max = (n_steps if visit_frac >= 1.0
+                   else max(1, int(math.ceil(n_steps * visit_frac))))
 
     def cond(carry):
         _hd2, _hidx, worst2, step, _tiles, _folds = carry
         next_d2 = lax.dynamic_index_in_dim(sorted_d2, jnp.minimum(
             step * v, num_pb - 1), axis=1, keepdims=False)
-        return (step < n_steps) & jnp.any(live(next_d2, worst2))
+        return (step < n_steps_max) & jnp.any(live(next_d2, worst2))
 
     def body(carry):
         hd2, hidx, worst2, step, tiles, folds = carry
@@ -288,7 +320,8 @@ def knn_update_tiled(state: CandidateState, q: BucketedPoints,
                 d2, ids = score_tile(
                     qp, ppf, pid.reshape(chunk, v * s_p), k,
                     score_dtype=score_dtype, mask=mask,
-                    pn2=pn2c.reshape(chunk, v * s_p) if use_mxu else None)
+                    pn2=pn2c.reshape(chunk, v * s_p) if use_mxu else None,
+                    skip_rescore=skip_rescore)
                 w = d2.shape[-1]
                 st = merge_candidates(
                     CandidateState(cd2.reshape(chunk * s_q, k),

@@ -156,19 +156,28 @@ class GracefulQueryFn:
                     == self.engine.engine_name):
                 raise e
 
-    def __call__(self, queries):
-        try:
-            return self.engine.query(queries)
-        except Exception as e:  # noqa: BLE001 - re-raised unless degradable
-            self._degrade_or_raise(e)
-            return self.engine.query(queries)
+    def _query(self, queries, plan):
+        # exact requests use the legacy single-arg form so engines (and
+        # test doubles) without a plan kwarg keep working — the batcher's
+        # compatibility rule, applied to the degradation shim too
+        return (self.engine.query(queries) if plan is None
+                else self.engine.query(queries, plan=plan))
 
-    def dispatch(self, queries):
+    def __call__(self, queries, plan=None):
         try:
-            return self.engine.dispatch(queries)
+            return self._query(queries, plan)
         except Exception as e:  # noqa: BLE001 - re-raised unless degradable
             self._degrade_or_raise(e)
-            return self.engine.dispatch(queries)
+            return self._query(queries, plan)
+
+    def dispatch(self, queries, plan=None):
+        try:
+            return (self.engine.dispatch(queries) if plan is None
+                    else self.engine.dispatch(queries, plan=plan))
+        except Exception as e:  # noqa: BLE001 - re-raised unless degradable
+            self._degrade_or_raise(e)
+            return (self.engine.dispatch(queries) if plan is None
+                    else self.engine.dispatch(queries, plan=plan))
 
     def complete(self, handle):
         try:
@@ -176,5 +185,7 @@ class GracefulQueryFn:
         except Exception as e:  # noqa: BLE001 - re-raised unless degradable
             self._degrade_or_raise(e, handle)
             # replay the retained host queries synchronously on the current
-            # (degraded) engine — exact by the twin-engine contract
-            return self.engine.query(handle.queries)
+            # (degraded) engine — exact by the twin-engine contract, under
+            # the SAME recall plan the handle was dispatched with
+            return self._query(handle.queries,
+                               getattr(handle, "plan", None))

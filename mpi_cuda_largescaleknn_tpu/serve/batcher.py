@@ -64,6 +64,10 @@ class _Request:
     queries: np.ndarray
     deadline: float | None
     enqueued: float
+    #: recall-SLO execution plan (serve/recall.py RecallPlan; None = exact).
+    #: Requests only coalesce with plan-compatible neighbors — see
+    #: ``_plan_key`` / ``_take_batch``.
+    plan: object | None = None
     done: threading.Event = field(default_factory=threading.Event)
     result: tuple | None = None
     error: Exception | None = None
@@ -71,6 +75,14 @@ class _Request:
     @property
     def rows(self) -> int:
         return len(self.queries)
+
+
+def _plan_key(req: _Request):
+    """The coalescing key: requests whose plans execute identical bits may
+    share an engine batch. None (exact) is its own key; approximate plans
+    key on ``batch_key()``, which deliberately EXCLUDES ``recall_target``
+    — two requests on the same plan at different targets coalesce."""
+    return None if req.plan is None else req.plan.batch_key()
 
 
 class DynamicBatcher:
@@ -135,6 +147,10 @@ class DynamicBatcher:
         self.rows_expired: guarded_by("_cond") = 0
         self.flush_full: guarded_by("_cond") = 0
         self.flush_deadline: guarded_by("_cond") = 0
+        # recall-SLO tier accounting: batches/rows that executed under an
+        # approximate plan (subset of batches/rows_served)
+        self.batches_approx: guarded_by("_cond") = 0
+        self.rows_served_approx: guarded_by("_cond") = 0
         # pipeline occupancy/stall accounting (under _cond); the stall
         # histogram shares the loadgen/server bucket geometry so the three
         # render identical /metrics buckets
@@ -168,15 +184,21 @@ class DynamicBatcher:
 
     # ------------------------------------------------------------------ submit
 
-    def submit(self, queries: np.ndarray, timeout_s: float | None = None):
+    def submit(self, queries: np.ndarray, timeout_s: float | None = None,
+               plan=None):
         """Block until the batch containing ``queries`` executes; returns
-        ``(dists, neighbors)`` or raises the request's error."""
+        ``(dists, neighbors)`` or raises the request's error. ``plan``
+        (serve/recall.py RecallPlan, None = exact) rides the request and
+        restricts coalescing to plan-compatible neighbors — mixed-SLO
+        traffic splits into per-plan sub-batches instead of forcing the
+        strictest plan on everyone."""
         # normalize to [n, dim] rows (flat inputs carry n*dim floats — the
         # legacy direct-caller contract, now D-generic via self.dim)
         queries = np.asarray(queries, np.float32).reshape(-1, self.dim)
         now = time.monotonic()
         req = _Request(queries=queries, enqueued=now,
-                       deadline=(now + timeout_s) if timeout_s else None)
+                       deadline=(now + timeout_s) if timeout_s else None,
+                       plan=plan)
         with self._cond:
             if self._shutdown:
                 raise RuntimeError("batcher is shut down")
@@ -230,15 +252,24 @@ class DynamicBatcher:
                     self._cond.wait()
             # pop whole requests while they fit; a single over-wide request
             # (> max_batch rows) was rejected upstream by admission sizing,
-            # but guard anyway by always taking at least one
+            # but guard anyway by always taking at least one. Plan-keyed
+            # sub-batching: only coalesce while the next request shares the
+            # head's plan batch_key — and never skip over a queued request
+            # (strict FIFO: a mixed-SLO queue flushes as consecutive
+            # per-plan runs, so no plan can starve another)
             batch = [self._queue.popleft()]
             rows = batch[0].rows
-            while self._queue and rows + self._queue[0].rows <= self.max_batch:
+            pkey = _plan_key(batch[0])
+            while (self._queue
+                   and rows + self._queue[0].rows <= self.max_batch
+                   and _plan_key(self._queue[0]) == pkey):
                 r = self._queue.popleft()
                 batch.append(r)
                 rows += r.rows
             self._queued_rows -= rows
             self.batches += 1
+            if pkey is not None:
+                self.batches_approx += 1
             if rows >= self.max_batch:
                 self.flush_full += 1
             else:
@@ -293,13 +324,19 @@ class DynamicBatcher:
                 t0 = time.perf_counter()
                 merged = (live[0].queries if len(live) == 1 else
                           np.concatenate([r.queries for r in live]))
-                outs = self._query_fn(merged)
+                # exact requests call the legacy single-arg form so plain
+                # test doubles (and the pre-tier wire) stay compatible
+                plan = live[0].plan
+                outs = (self._query_fn(merged) if plan is None
+                        else self._query_fn(merged, plan=plan))
                 if self._timers is not None:
                     self._timers.hist("batch_exec_seconds").record(
                         time.perf_counter() - t0)
                 self._deliver(live, outs)
                 with self._cond:
                     self.rows_served += len(merged)
+                    if plan is not None:
+                        self.rows_served_approx += len(merged)
             except Exception as e:  # noqa: BLE001 - delivered per request
                 self._fail(live, e)
 
@@ -362,7 +399,9 @@ class DynamicBatcher:
                 self._timers.gauge("pipeline_inflight_batches", inflight)
             try:
                 t0 = time.perf_counter()
-                handle = self._query_fn.dispatch(merged)
+                plan = live[0].plan
+                handle = (self._query_fn.dispatch(merged) if plan is None
+                          else self._query_fn.dispatch(merged, plan=plan))
             except Exception as e:  # noqa: BLE001 - delivered per request
                 self._fail(live, e)
                 with self._cond:
@@ -425,6 +464,8 @@ class DynamicBatcher:
                 self._deliver(live, outs)
                 with self._cond:
                     self.rows_served += rows
+                    if live[0].plan is not None:
+                        self.rows_served_approx += rows
             except Exception as e:  # noqa: BLE001 - delivered per request
                 self._fail(live, e)
             finally:
@@ -463,6 +504,8 @@ class DynamicBatcher:
                 "rows_expired": self.rows_expired,
                 "flush_full": self.flush_full,
                 "flush_deadline": self.flush_deadline,
+                "batches_approx": self.batches_approx,
+                "rows_served_approx": self.rows_served_approx,
                 "queue_rows": self._queued_rows,
                 "mean_batch_rows": round(
                     self.rows_served / self.batches, 2) if self.batches else 0,

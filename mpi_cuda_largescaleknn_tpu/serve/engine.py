@@ -172,14 +172,17 @@ class _InFlightBatch:
     sort (None when sorting is off): row i of the staged batch is
     ``queries[perm[i]]``, so ``complete`` scatters results back through it.
     ``tiles_possible`` is the program's static tile-schedule ceiling — the
-    skipped-tile counter's denominator.
+    skipped-tile counter's denominator. ``plan`` is the recall-SLO
+    execution plan the batch was dispatched under (serve/recall.py;
+    None = exact) — retained so a degradation replay re-runs the SAME
+    plan and the completion layers can label the batch's tier.
     """
 
     __slots__ = ("queries", "n", "qpad", "engine_name", "merge_mode",
-                 "fut", "t0", "perm", "tiles_possible")
+                 "fut", "t0", "perm", "tiles_possible", "plan")
 
     def __init__(self, queries, n, qpad, engine_name, merge_mode, fut, t0,
-                 perm=None, tiles_possible=0):
+                 perm=None, tiles_possible=0, plan=None):
         self.queries = queries
         self.n = n
         self.qpad = qpad
@@ -189,6 +192,7 @@ class _InFlightBatch:
         self.t0 = t0
         self.perm = perm
         self.tiles_possible = tiles_possible
+        self.plan = plan
 
 
 class ResidentKnnEngine:
@@ -498,7 +502,8 @@ class ResidentKnnEngine:
         raise UnservableShapeError(
             f"batch of {n} queries exceeds max_batch {self.max_batch}")
 
-    def _build_query_fn(self, engine_name: str, qpad: int, qbuckets: int):
+    def _build_query_fn(self, engine_name: str, qpad: int, qbuckets: int,
+                        plan_key: tuple | None = None):
         import jax
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
@@ -578,6 +583,17 @@ class ResidentKnnEngine:
                 resident = BucketedPoints(bpts, bids, blo, bhi, bids)
                 kw = dict(with_stats=True, canonical_ties=canonical,
                           score_dtype=score_dtype, point_norms2=bn2)
+                if plan_key is not None:
+                    # recall-SLO program knobs (serve/recall.py
+                    # RecallPlan.program_key()): trace-time statics, so
+                    # this body is a DIFFERENT compiled program from the
+                    # exact one — the executable keys carry plan_key.
+                    # Only the XLA tiled engine understands them
+                    # (_get_executable nulls plan_key otherwise).
+                    skip_rescore, prune_shrink, visit_frac = plan_key
+                    kw.update(skip_rescore=skip_rescore,
+                              prune_shrink=prune_shrink,
+                              visit_frac=visit_frac)
                 if engine_name == "tiled":
                     # chunk = ONE query bucket: the lax.map cond skips at
                     # per-bucket granularity, so a finished bucket stops
@@ -648,23 +664,37 @@ class ResidentKnnEngine:
         # shards this process fetches counts from (_tiles_fetch)
         return len(self._my_pos) * qpad * per_row
 
-    def _get_executable(self, qpad: int):  # lsk: holds[_lock]
+    def _get_executable(self, qpad: int, plan=None):  # lsk: holds[_lock]
         """AOT executable for (active engine, qpad); compiles on miss.
 
         ``compile_count`` increments EXACTLY when XLA is invoked — the
         recompile-freedom contract the tests assert. A compiled executable
         rejects any other input shape instead of silently retracing.
         Device-merge programs are distinct HLO from host-merge ones, and so
-        are different query bucketings and score dtypes, so all are part of
-        the bucket key — the recompile-freedom discipline holds per
-        (engine, merge, shape, query_buckets, score_dtype) tuple.
+        are different query bucketings, score dtypes and recall-plan
+        program knobs, so all are part of the bucket key — the
+        recompile-freedom discipline holds per (engine, merge, shape,
+        query_buckets, score_dtype, plan) tuple. ``plan`` (serve/recall.py
+        ``RecallPlan``, None = exact) appends its ``program_key()`` at the
+        END of the key so the exact path's keys — and the qpad-at-index-2
+        layout ``ExecutableCache.stats`` reads — stay byte-identical to
+        the pre-tier engine. Program knobs need the XLA tiled traversal;
+        on other engines the plan runs the exact program (recall can only
+        exceed the claim).
         """
         import jax
 
         qb = self.query_buckets[qpad]
         with self._meta_lock:
             engine_name = self.engine_name
+        plan_key = None
+        if plan is not None and engine_name == "tiled":
+            pk = plan.program_key()
+            if pk != (False, 1.0, 1.0):
+                plan_key = pk
         key = (engine_name, self.merge_mode, qpad, qb, self.score_dtype)
+        if plan_key is not None:
+            key = key + (plan_key,)
         exe = self._executables.get(key)
         if exe is not None:
             return exe
@@ -691,7 +721,8 @@ class ResidentKnnEngine:
             # put below — or the abort, if the compile fails
         try:
             with self.timers.phase(f"compile_q{qpad}"):
-                fn = self._build_query_fn(engine_name, qpad, qb)
+                fn = self._build_query_fn(engine_name, qpad, qb,
+                                          plan_key=plan_key)
                 q0 = self._stage_replicated(
                     np.full((qpad, self.dim), PAD_SENTINEL, np.float32))
                 exe = fn.lower(*self._resident_args(engine_name),
@@ -823,7 +854,7 @@ class ResidentKnnEngine:
                 max_workers=n, thread_name_prefix="knn-launch")
             old.shutdown(wait=False)
 
-    def dispatch(self, queries: np.ndarray) -> _InFlightBatch:
+    def dispatch(self, queries: np.ndarray, plan=None) -> _InFlightBatch:
         """Issue a batch's device traversal WITHOUT blocking on the result.
 
         Morton-sorts (when enabled), stages + pads the batch, replicates
@@ -837,6 +868,10 @@ class ResidentKnnEngine:
         ``complete``'s demux. The lock serializes executable lookup,
         staging, and launch-queue order with ``degrade``; it is NOT held
         while the device computes or the host merges.
+
+        ``plan`` (serve/recall.py ``RecallPlan``, None = exact) selects
+        the plan-keyed approximate executable and rides the handle so a
+        degradation replay re-runs the same plan.
         """
         import jax
 
@@ -848,7 +883,8 @@ class ResidentKnnEngine:
             with self._meta_lock:
                 name = self.engine_name
             return _InFlightBatch(queries, 0, 0, name,
-                                  self.merge_mode, None, time.perf_counter())
+                                  self.merge_mode, None, time.perf_counter(),
+                                  plan=plan)
         qpad = self.bucket_for(n)
         perm = None
         if self.sort_queries and n > 1:
@@ -857,7 +893,7 @@ class ResidentKnnEngine:
                                       self._index_hi)
         staged = queries if perm is None else queries[perm]
         with self._lock:
-            exe = self._get_executable(qpad)
+            exe = self._get_executable(qpad, plan=plan)
             with self._meta_lock:
                 # consistent with the key _get_executable compiled under:
                 # degrade() needs _lock, which this region holds
@@ -869,9 +905,11 @@ class ResidentKnnEngine:
             q_dev = self._stage_replicated(q)
             fut = self._launch.submit(exe, *args, q_dev)
             possible = self._tiles_possible(engine_name, qpad)
+        if plan is not None:
+            self.timers.count("approx_batches")
         return _InFlightBatch(queries, n, qpad, engine_name,
                               self.merge_mode, fut, t0, perm=perm,
-                              tiles_possible=possible)
+                              tiles_possible=possible, plan=plan)
 
     def complete(self, batch: _InFlightBatch):
         """Block on a dispatched batch and finish its cross-shard top-k.
@@ -1034,7 +1072,7 @@ class ResidentKnnEngine:
         self.timers.count("result_rows", len(rows))
         return rows, np.concatenate(d_l), np.concatenate(n_l)
 
-    def query(self, queries: np.ndarray):
+    def query(self, queries: np.ndarray, plan=None):
         """f32[n,3] -> (f32[n] k-th-NN distances, i32[n,k] neighbor ids).
 
         Serialized ``dispatch`` + ``complete``. ``n`` may be anything in
@@ -1043,9 +1081,10 @@ class ResidentKnnEngine:
         the reference contract: sqrt of the k-th smallest squared distance,
         inf (or the ``-r`` radius) when fewer than k neighbors exist.
         Neighbor ids are global point indices, ascending by distance, -1 for
-        unfilled slots.
+        unfilled slots. With a recall ``plan``, distances/sets are the
+        plan's approximation instead (still sorted, -1-padded).
         """
-        return self.complete(self.dispatch(queries))
+        return self.complete(self.dispatch(queries, plan=plan))
 
     def stats(self) -> dict:
         # the mutable identity (engine_name / degraded_reason /
@@ -1112,6 +1151,9 @@ class ResidentKnnEngine:
             # nests the same values among phases/histograms for --timings)
             "fetch_bytes": self.timers.counter("fetch_bytes"),
             "result_rows": self.timers.counter("result_rows"),
+            # recall-SLO tier: batches dispatched under an approximate plan
+            # (serve/recall.py) — 0 on an exact-only deployment
+            "approx_batches": self.timers.counter("approx_batches"),
             "timers": self.timers.report(),
         }
 

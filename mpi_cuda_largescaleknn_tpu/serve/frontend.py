@@ -123,10 +123,12 @@ from mpi_cuda_largescaleknn_tpu.serve.health import (
     HostHealth,
     host_fingerprint,
 )
+from mpi_cuda_largescaleknn_tpu.serve.recall import RecallPolicy
 from mpi_cuda_largescaleknn_tpu.serve.server import (
     JsonHttpHandler,
     ServingMetrics,
     parse_knn_body,
+    recall_response_fields,
     slab_pool_prometheus_lines,
 )
 from mpi_cuda_largescaleknn_tpu.utils.math import aabb_lower_bound_dist2
@@ -1104,7 +1106,7 @@ class RoutedPodFanout(PodFanout):
 
     # ---------------------------------------------------------- query_fn API
 
-    def dispatch(self, queries: np.ndarray):
+    def dispatch(self, queries: np.ndarray, plan=None):
         """Wave 1: each query to its nearest-bounds AVAILABLE slab (one
         picked replica of it), PLUS every available slab whose boxes
         contain it (non-blocking). A zero lower bound can never be
@@ -1114,7 +1116,13 @@ class RoutedPodFanout(PodFanout):
         boundary traffic's latency. A slab is unavailable only when EVERY
         replica is drained — a single drained host is simply routed
         around; whether the answers a fully-down slab would have touched
-        are 503d or served degraded is ``complete``'s caller's policy."""
+        are 503d or served degraded is ``complete``'s caller's policy.
+
+        ``plan`` (serve/recall.py RecallPlan, None = exact) is FRONTEND
+        side only here: the /route_knn wire is unchanged (hosts always
+        serve their exact slab partials) and the plan's ``route_slack``
+        shaves ``complete``'s escalation margin — fewer boundary waves,
+        bounded recall cost."""
         q = np.ascontiguousarray(np.asarray(queries, np.float32)
                                  .reshape(-1, self.dim))
         n = len(q)
@@ -1138,7 +1146,15 @@ class RoutedPodFanout(PodFanout):
             for s, _ep_i, rows, _f in futs:
                 visited[rows, s] = True
         return {"q": q, "n": n, "lb": lb, "visited": visited,
-                "futs": futs, "t0": time.perf_counter()}
+                "futs": futs, "t0": time.perf_counter(), "plan": plan}
+
+    #: the front end resolves recall plans only against fan-outs that
+    #: accept them; the replicate pod (base class) stays plan-blind and
+    #: serves every target exactly
+    supports_recall = True
+
+    def __call__(self, queries, plan=None):
+        return self.complete(self.dispatch(queries, plan=plan))
 
     def complete(self, handle):
         """Fold wave partials; escalate uncertified (query, slab) pairs.
@@ -1164,6 +1180,11 @@ class RoutedPodFanout(PodFanout):
                     np.zeros(0, bool))
         q, visited = handle["q"], handle["visited"]
         num_slabs = self.replicas.num_slabs
+        # recall plan (knob c): escalate only when a bound beats the kth
+        # distance by the plan's slack margin — fewer boundary waves at a
+        # bounded recall cost; 0.0 (exact) keeps certification exact
+        plan = handle.get("plan")
+        slack = float(plan.route_slack) if plan is not None else 0.0
         # the dim-scaled slack makes the certification conservative
         # against the engines' f32 rounding (routing_cert_slack)
         lb_safe = handle["lb"] * (1.0 - self.cert_slack)
@@ -1204,7 +1225,8 @@ class RoutedPodFanout(PodFanout):
                 dts.append(dt)
                 fold_candidates(cur_d2, cur_idx, rows, d2, idx, k)
             r2 = cur_d2[:, k - 1].astype(np.float64)
-            need = (~visited) & reachable & (lb_safe <= r2[:, None])
+            need = (~visited) & reachable & (
+                lb_safe <= r2[:, None] * (1.0 - slack))
             avail = self.replicas.slab_live_mask(
                 penalties=batch_failures, budget=self.retries)
             dispatchable = need & avail[None, :]
@@ -1228,8 +1250,10 @@ class RoutedPodFanout(PodFanout):
                 visited[rows, s] = True
         # certification closed over the AVAILABLE slabs; whatever remains
         # uncertified points at fully-down slabs — those queries are
-        # inexact
-        uncertified = (~visited) & reachable & (lb_safe <= r2[:, None])
+        # inexact (judged under the plan's slack: the approximate tier
+        # flags its rows inexact at the response layer regardless)
+        uncertified = (~visited) & reachable & (
+            lb_safe <= r2[:, None] * (1.0 - slack))
         exact = ~uncertified.any(axis=1)
         with self._lock:
             self.batches += 1
@@ -1306,11 +1330,16 @@ class FrontendServer(ThreadingHTTPServer):
     def __init__(self, addr, fanout: PodFanout, *, max_delay_s=0.002,
                  max_queue_rows=4096, default_timeout_s=5.0,
                  pipeline_depth=2, min_batch=8, on_host_loss="fail",
-                 verbose=False):
+                 verbose=False, recall_policy=None):
         if on_host_loss not in ("fail", "degrade"):
             raise ValueError(f"on_host_loss must be 'fail' or 'degrade', "
                              f"got {on_host_loss!r}")
         self.fanout = fanout
+        #: recall-SLO tier (serve/recall.py). Plans only engage on a
+        #: routed fan-out (``supports_recall``); a replicate pod serves
+        #: every target exactly — exact always meets any target.
+        self.recall_policy = (RecallPolicy() if recall_policy is None
+                              else recall_policy)
         #: what happens to queries whose certified routing set touches a
         #: drained slab: "fail" 503s them (exactness preserved), "degrade"
         #: serves the surviving hosts' fold flagged ``exact: false``
@@ -1406,6 +1435,8 @@ class _FrontendHandler(JsonHttpHandler):
                 "admission": srv.admission.stats(),
                 "server": dict(srv.metrics.snapshot(),
                                request_latency=srv.metrics.latency.report()),
+                "recall": dict(srv.metrics.recall_snapshot(),
+                               policy=srv.recall_policy.stats()),
                 "hosts": srv.fanout.scrape_host_stats(),
             })
         elif path == "/metrics":
@@ -1529,6 +1560,8 @@ class _FrontendHandler(JsonHttpHandler):
                     "# TYPE knn_handoff_seconds_total counter",
                     f"knn_handoff_seconds_total "
                     f"{handoff['handoff_seconds_total']}"]
+        # recall-SLO tier: exact/approx split + recall_estimated histogram
+        lines += srv.metrics.recall_prometheus_lines()
         lines += srv.metrics.latency.prometheus_lines(
             "knn_request_latency_seconds")
         for src, prom in (("fanout_batch_seconds", "knn_fanout_batch_seconds"),
@@ -1549,13 +1582,18 @@ class _FrontendHandler(JsonHttpHandler):
         srv.metrics.inc("knn_requests_total")
         t0 = time.perf_counter()
         try:
-            q, want_nbrs, timeout_s, binary = parse_knn_body(
+            q, want_nbrs, timeout_s, recall, binary = parse_knn_body(
                 self.path, self.headers, self.rfile,
                 dim=getattr(srv.fanout, "dim", 3))
         except (ValueError, json.JSONDecodeError) as e:
             srv.metrics.inc("knn_badrequest_total")
             self._send_json(400, {"error": str(e)})
             return
+        # plans only engage on a routed fan-out; a replicate pod is
+        # plan-blind and serves the target exactly (plan stays None)
+        plan = (srv.recall_policy.plan_for(recall)
+                if recall is not None
+                and getattr(srv.fanout, "supports_recall", False) else None)
         timeout_s = timeout_s or srv.admission.default_timeout_s
         n = len(q)
         if n > srv.fanout.max_batch:
@@ -1572,7 +1610,7 @@ class _FrontendHandler(JsonHttpHandler):
             return
         try:
             with srv.admission.admitted_rows(n):
-                res = srv.batcher.submit(q, timeout_s=timeout_s)
+                res = srv.batcher.submit(q, timeout_s=timeout_s, plan=plan)
         except OverloadError as e:
             srv.metrics.inc("knn_overload_total")
             self._send_json(429, {"error": str(e)},
@@ -1614,11 +1652,19 @@ class _FrontendHandler(JsonHttpHandler):
         if not all_exact:
             srv.metrics.inc("knn_degraded_responses_total")
         srv.metrics.inc("knn_rows_total", n)
+        srv.metrics.note_recall(plan)
         srv.metrics.latency.record(time.perf_counter() - t0)
+        fields, rhdrs = recall_response_fields(plan, recall)
+        if plan is None and not all_exact:
+            # a target served on the exact plan but degraded by host loss
+            # must not claim exactness — the degradation surface below
+            # (exact/exact_per_query, X-Knn-Exact) is the truthful answer
+            fields, rhdrs = {}, []
         if binary:
             self._send(200, np.asarray(dists, "<f4").tobytes(),
                        "application/octet-stream",
-                       extra=([] if exact is None else
+                       extra=(rhdrs if rhdrs else
+                              [] if exact is None else
                               [("X-Knn-Exact", "1" if all_exact else "0")]))
         else:
             out = {"dists": np.asarray(dists, np.float64).tolist()}
@@ -1628,6 +1674,7 @@ class _FrontendHandler(JsonHttpHandler):
                 out["exact"] = all_exact
                 if not all_exact:
                     out["exact_per_query"] = [bool(x) for x in exact]
+            out.update(fields)
             self._send_json(200, out)
 
 

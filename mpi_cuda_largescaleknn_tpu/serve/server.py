@@ -43,6 +43,7 @@ from mpi_cuda_largescaleknn_tpu.serve.faults import (
     FaultInjector,
     apply_http_fault,
 )
+from mpi_cuda_largescaleknn_tpu.serve.recall import RecallPolicy
 
 
 def parse_knn_body(path: str, headers, rfile, dim: int = 3):
@@ -50,19 +51,26 @@ def parse_knn_body(path: str, headers, rfile, dim: int = 3):
 
     ``dim`` is the serving index's point dimensionality (the engine's
     ``dim`` attribute — the stack is D-generic; 3 is just the default).
-    -> (queries f32[n,dim], want_neighbors, timeout_s, binary)."""
+    -> (queries f32[n,dim], want_neighbors, timeout_s, recall, binary).
+
+    ``recall`` is the request's recall-SLO target (serve/recall.py): the
+    JSON body's ``"recall": 0.95`` key, or ``recall=0.95`` on the query
+    string (the binary codec's only option channel). ``None`` — the
+    default — means exact; values outside (0, 1] are a 400."""
     qs = parse_qs(urlparse(path).query)
     length = int(headers.get("Content-Length", 0))
     raw = rfile.read(length)
     ctype = (headers.get("Content-Type") or "").split(";")[0].strip()
     timeout_ms = float(qs.get("timeout_ms", [0])[0] or 0)
     neighbors = qs.get("neighbors", ["0"])[0] not in ("0", "", "false")
+    recall_qs = qs.get("recall", [None])[0]
+    recall = float(recall_qs) if recall_qs not in (None, "") else None
     if ctype == "application/octet-stream":
         if len(raw) % (4 * dim):
             raise ValueError(
                 f"binary body must be n*{4 * dim} bytes (f32 x{dim})")
         q = np.frombuffer(raw, "<f4").reshape(-1, dim)
-        return q, neighbors, timeout_ms / 1e3, True
+        return q, neighbors, timeout_ms / 1e3, _check_recall(recall), True
     obj = json.loads(raw.decode() or "{}")
     q = np.asarray(obj.get("queries", []), np.float32)
     if q.size == 0:
@@ -72,7 +80,16 @@ def parse_knn_body(path: str, headers, rfile, dim: int = 3):
     if not np.all(np.isfinite(q)):
         raise ValueError("queries must be finite")
     timeout_ms = float(obj.get("timeout_ms", timeout_ms) or 0)
-    return q, bool(obj.get("neighbors", neighbors)), timeout_ms / 1e3, False
+    if obj.get("recall") is not None:
+        recall = float(obj["recall"])
+    return (q, bool(obj.get("neighbors", neighbors)), timeout_ms / 1e3,
+            _check_recall(recall), False)
+
+
+def _check_recall(recall: float | None) -> float | None:
+    if recall is not None and not 0.0 < recall <= 1.0:
+        raise ValueError(f"recall target must be in (0, 1], got {recall}")
+    return recall
 
 
 def slab_pool_prometheus_lines(engine_stats: dict) -> list[str]:
@@ -108,7 +125,51 @@ def slab_pool_prometheus_lines(engine_stats: dict) -> list[str]:
         f'knn_slab_pool_cold_reads_total {pool["cold_reads"]}',
         "# TYPE knn_slab_prefetch_enqueued_total counter",
         f'knn_slab_prefetch_enqueued_total {pool["prefetch_enqueued"]}',
+    ] + _streaming_prometheus_lines(engine_stats)
+
+
+def _streaming_prometheus_lines(engine_stats: dict) -> list[str]:
+    streaming = engine_stats.get("streaming")
+    if not streaming:
+        return []
+    return [
+        # recall-SLO tier (serve/recall.py stream_skip_cold): cold-slab
+        # promotions given up for recall instead of stalled on — the
+        # "stalls into recall" trade as a number
+        "# TYPE knn_stream_skipped_promotions_total counter",
+        f"knn_stream_skipped_promotions_total "
+        f"{streaming['skipped_promotions']}",
     ]
+
+
+#: knn_recall_estimated histogram upper edges (plan-level calibrated
+#: recall per approximate request); +Inf bucket rides implicitly
+RECALL_HIST_EDGES = (0.5, 0.8, 0.9, 0.95, 0.99, 1.0)
+
+
+def recall_response_fields(plan, recall):
+    """The response surface of one request's recall resolution, shared by
+    the single-host server and the pod front end: ``(json_fields,
+    binary_headers)``. Exact requests (no target at all) get neither —
+    the pre-tier wire stays byte-identical. A target served EXACTLY
+    (recall=1.0, or a target no calibrated plan meets) is answered
+    ``exact: true`` with a 1.0 estimate — serving exact always meets any
+    target."""
+    if plan is None:
+        if recall is None:
+            return {}, []
+        return ({"exact": True, "recall_target": float(recall),
+                 "recall_estimated": 1.0},
+                [("X-Knn-Exact", "1"),
+                 ("X-Knn-Recall-Target", f"{recall:g}"),
+                 ("X-Knn-Recall-Estimated", "1")])
+    return ({"exact": False, "recall_target": float(plan.recall_target),
+             "recall_estimated": float(plan.recall_estimated),
+             "recall_plan": plan.name},
+            [("X-Knn-Exact", "0"),
+             ("X-Knn-Recall-Target", f"{plan.recall_target:g}"),
+             ("X-Knn-Recall-Estimated", f"{plan.recall_estimated:g}"),
+             ("X-Knn-Recall-Plan", plan.name)])
 
 
 class ServingMetrics:
@@ -122,6 +183,14 @@ class ServingMetrics:
             "knn_overload_total": 0, "knn_deadline_total": 0,
             "knn_badrequest_total": 0, "knn_error_total": 0}
         self.latency = LatencyHistogram()
+        # recall-SLO tier accounting: requests per tier plus a fixed-edge
+        # histogram of the approximate responses' calibrated
+        # recall_estimated (plan-level — every row of an approx request
+        # shares its plan's claim)
+        self.recall_tiers: guarded_by("_lock") = {"exact": 0, "approx": 0}
+        self.recall_hist: guarded_by("_lock") = (
+            [0] * (len(RECALL_HIST_EDGES) + 1))
+        self.recall_hist_sum: guarded_by("_lock") = 0.0
 
     def snapshot(self) -> dict:
         """Locked point-in-time copy — what cross-object readers use
@@ -135,14 +204,65 @@ class ServingMetrics:
             # hosts' knn_routed_rows_total) appear on first increment
             self.counters[name] = self.counters.get(name, 0) + by
 
+    def note_recall(self, plan) -> None:
+        """Record one request's recall tier (``plan`` is None for exact,
+        a serve/recall.py RecallPlan otherwise)."""
+        with self._lock:
+            if plan is None:
+                self.recall_tiers["exact"] += 1
+                return
+            self.recall_tiers["approx"] += 1
+            r = float(plan.recall_estimated)
+            self.recall_hist_sum += r
+            for i, edge in enumerate(RECALL_HIST_EDGES):
+                if r <= edge:
+                    self.recall_hist[i] += 1
+                    break
+            else:
+                self.recall_hist[-1] += 1
+
+    def recall_snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "tiers": dict(self.recall_tiers),
+                "estimated_hist": {
+                    "edges": list(RECALL_HIST_EDGES),
+                    "counts": list(self.recall_hist),
+                    "sum": round(self.recall_hist_sum, 6),
+                    "count": self.recall_tiers["approx"]},
+            }
+
+    def recall_prometheus_lines(self) -> list[str]:
+        snap = self.recall_snapshot()
+        lines = ["# TYPE knn_recall_requests_total counter"] + [
+            f'knn_recall_requests_total{{tier="{t}"}} {v}'
+            for t, v in sorted(snap["tiers"].items())]
+        h = snap["estimated_hist"]
+        lines += ["# TYPE knn_recall_estimated histogram"]
+        cum = 0
+        for edge, c in zip(h["edges"], h["counts"]):
+            cum += c
+            lines += [f'knn_recall_estimated_bucket{{le="{edge}"}} {cum}']
+        lines += [f'knn_recall_estimated_bucket{{le="+Inf"}} {h["count"]}',
+                  f"knn_recall_estimated_sum {h['sum']}",
+                  f"knn_recall_estimated_count {h['count']}"]
+        return lines
+
 
 class KnnServer(ThreadingHTTPServer):
     daemon_threads = True
 
     def __init__(self, addr, engine, *, max_delay_s=0.002,
                  max_queue_rows=4096, default_timeout_s=5.0, query_fn=None,
-                 verbose=False, pipeline_depth=2, faults=None):
+                 verbose=False, pipeline_depth=2, faults=None,
+                 recall_policy=None):
         self.engine = engine
+        #: recall-SLO tier (serve/recall.py): maps a request's
+        #: ``"recall": 0.95`` target to a calibrated cheaper plan. The
+        #: built-in table serves by default; operators swap in a
+        #: harness-calibrated one via --recall-policy (cli/serve_main.py)
+        self.recall_policy = (RecallPolicy() if recall_policy is None
+                              else recall_policy)
         #: deterministic fault injection (serve/faults.py; KNN_FAULTS env)
         #: — the single-host twin of the pod hosts' injector, so failure
         #: drills run against any serving tier
@@ -242,6 +362,8 @@ class _Handler(JsonHttpHandler):
                 "admission": srv.admission.stats(),
                 "server": dict(srv.metrics.snapshot(),
                                request_latency=srv.metrics.latency.report()),
+                "recall": dict(srv.metrics.recall_snapshot(),
+                               policy=srv.recall_policy.stats()),
             })
         elif path == "/metrics":
             self._send(200, self._prometheus(srv).encode(),
@@ -318,6 +440,9 @@ class _Handler(JsonHttpHandler):
         # promotion/eviction totals, stream-stall accounting — absent for
         # fully-resident engines
         lines += slab_pool_prometheus_lines(e)
+        # recall-SLO tier: exact/approx request split plus the calibrated
+        # recall_estimated distribution of the approximate responses
+        lines += srv.metrics.recall_prometheus_lines()
         lines += srv.metrics.latency.prometheus_lines(
             "knn_request_latency_seconds")
         for src, prom in (("engine_batch_seconds",
@@ -331,7 +456,7 @@ class _Handler(JsonHttpHandler):
 
     # ------------------------------------------------------------------ POST
     def _parse_body(self):
-        """-> (queries f32[n,dim], want_neighbors, timeout_s, binary)."""
+        """-> (queries, want_neighbors, timeout_s, recall, binary)."""
         return parse_knn_body(self.path, self.headers, self.rfile,
                               dim=getattr(self.server.engine, "dim", 3))
 
@@ -345,11 +470,15 @@ class _Handler(JsonHttpHandler):
         srv.metrics.inc("knn_requests_total")
         t0 = time.perf_counter()
         try:
-            q, want_nbrs, timeout_s, binary = self._parse_body()
+            q, want_nbrs, timeout_s, recall, binary = self._parse_body()
         except (ValueError, json.JSONDecodeError) as e:
             srv.metrics.inc("knn_badrequest_total")
             self._send_json(400, {"error": str(e)})
             return
+        # recall-SLO resolution: a target of 1.0 (or one no calibrated plan
+        # meets) falls through to plan=None — the exact path, untouched
+        plan = (srv.recall_policy.plan_for(recall)
+                if recall is not None else None)
         timeout_s = timeout_s or srv.admission.default_timeout_s
         n = len(q)
         if n > srv.engine.max_batch:
@@ -366,7 +495,8 @@ class _Handler(JsonHttpHandler):
             return
         try:
             with srv.admission.admitted_rows(n):
-                dists, nbrs = srv.batcher.submit(q, timeout_s=timeout_s)
+                dists, nbrs = srv.batcher.submit(q, timeout_s=timeout_s,
+                                                 plan=plan)
         except OverloadError as e:
             srv.metrics.inc("knn_overload_total")
             self._send_json(429, {"error": str(e)},
@@ -385,14 +515,17 @@ class _Handler(JsonHttpHandler):
             self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
             return
         srv.metrics.inc("knn_rows_total", n)
+        srv.metrics.note_recall(plan)
         srv.metrics.latency.record(time.perf_counter() - t0)
+        fields, hdrs = recall_response_fields(plan, recall)
         if binary:
             self._send(200, np.asarray(dists, "<f4").tobytes(),
-                       "application/octet-stream")
+                       "application/octet-stream", extra=hdrs)
         else:
             out = {"dists": np.asarray(dists, np.float64).tolist()}
             if want_nbrs:
                 out["neighbors"] = np.asarray(nbrs).tolist()
+            out.update(fields)
             self._send_json(200, out)
 
 

@@ -33,11 +33,16 @@ order can never change bits), and uncertified (query, slab) pairs
 escalate in waves exactly like the routed pod
 (``lb * (1 - routing_cert_slack) <= kth²`` keeps a slab in play) until
 every skipped slab is CERTIFIED unable to contribute. Exactness is never
-traded: a needed slab that misses both warm tiers STALLS the batch
-(counted in ``knn_stream_stall_seconds_total``), it is never skipped or
-approximated — results are bit-identical to a fully-resident engine at
-EVERY pool size (tests/test_slabpool.py's parity matrix over budgets
-{1 slab, half, all}).
+traded by DEFAULT: a needed slab that misses both warm tiers STALLS the
+batch (counted in ``knn_stream_stall_seconds_total``), it is never
+skipped or approximated — results are bit-identical to a fully-resident
+engine at EVERY pool size (tests/test_slabpool.py's parity matrix over
+budgets {1 slab, half, all}). A request that OPTS INTO the recall-SLO
+tier (serve/recall.py, ``stream_skip_cold``) inverts exactly that one
+trade: cold promotions whose bounds could still beat the kth distance
+are skipped for recall instead of stalled on
+(``stream_skipped_promotions``), and the slab warms asynchronously for
+the next batch.
 
 Overlap is what makes the tiers affordable (TPU-KNN, arXiv:2206.14286:
 the scorer must never starve): ``dispatch`` PINS the batch's slab set
@@ -529,12 +534,13 @@ class _StreamHandle:
     """A dispatched-but-uncompleted streaming batch: the original queries
     (degradation replay + escalation sub-batches), the bounds table's
     lower bounds, the visited matrix, the per-slab in-flight sub-batches,
-    and the pinned slab set ``complete`` releases."""
+    the pinned slab set ``complete`` releases, and the recall plan
+    (serve/recall.py, None = exact) the batch runs under."""
 
     __slots__ = ("queries", "n", "engine_name", "t0", "lb", "visited",
-                 "subs", "pinned")
+                 "subs", "pinned", "plan")
 
-    def __init__(self, queries, n, engine_name, t0):
+    def __init__(self, queries, n, engine_name, t0, plan=None):
         self.queries = queries
         self.n = n
         self.engine_name = engine_name
@@ -543,6 +549,7 @@ class _StreamHandle:
         self.visited = None
         self.subs = []
         self.pinned = set()
+        self.plan = plan
 
 
 class StreamingKnnEngine:
@@ -779,7 +786,7 @@ class StreamingKnnEngine:
 
     # --------------------------------------------------------------- query API
 
-    def dispatch(self, queries: np.ndarray) -> _StreamHandle:
+    def dispatch(self, queries: np.ndarray, plan=None) -> _StreamHandle:
         """Wave 1 of the streamed batch: route rows to their
         nearest-bounds slab plus every slab whose box contains them (the
         PR-7 rule — a zero lower bound can never certify away), PIN that
@@ -787,15 +794,37 @@ class StreamingKnnEngine:
         launch the per-slab sub-batches on the slab engines' async launch
         pools. Also enqueues prefetch for the next-nearest
         ``prefetch_depth`` slabs — the likely escalation targets — so an
-        escalation wave finds them warm."""
+        escalation wave finds them warm.
+
+        ``plan`` (serve/recall.py RecallPlan, None = exact): the program
+        knobs ride into each slab engine's plan-keyed executable, and
+        ``stream_skip_cold`` defers every cold wave-1 slab except each
+        query's NEAREST one (always ensured, even cold, so every row gets
+        k real candidates) — deferred slabs warm asynchronously and are
+        reconsidered against the folded kth distance in the escalation
+        loop, where a still-cold one is SKIPPED for recall instead of
+        stalled on (``stream_skipped_promotions``)."""
         queries = np.ascontiguousarray(
             np.asarray(queries, np.float32).reshape(-1, self.dim))
         n = len(queries)
-        handle = _StreamHandle(queries, n, self.engine_name, self._clock())
+        handle = _StreamHandle(queries, n, self.engine_name, self._clock(),
+                               plan=plan)
         if n == 0:
             return handle
         lb, want = self._wave1_want(queries)
         visited = np.zeros((n, self.num_slabs), bool)
+        if plan is not None and plan.stream_skip_cold:
+            resident = set(self._pool.resident_slabs())
+            first = np.argmin(lb, axis=1)
+            must = set(int(s) for i, s in enumerate(first)
+                       if np.isfinite(lb[i, s]))
+            deferred = [s for s in np.nonzero(want.any(axis=0))[0].tolist()
+                        if s not in resident and s not in must]
+            if deferred:
+                # serve this batch from what is warm; warm the rest UNDER
+                # its compute for the escalation pass / future batches
+                want[:, deferred] = False
+                self._pool.prefetch(deferred)
         wave = [(s, np.nonzero(want[:, s])[0])
                 for s in range(self.num_slabs) if want[:, s].any()]
         sids = [s for s, _rows in wave]
@@ -808,8 +837,10 @@ class StreamingKnnEngine:
         try:
             for s, rows in wave:
                 eng = self._pool.ensure(s)
-                handle.subs.append((s, rows, eng,
-                                    eng.dispatch(queries[rows])))
+                handle.subs.append((
+                    s, rows, eng,
+                    eng.dispatch(queries[rows]) if plan is None
+                    else eng.dispatch(queries[rows], plan=plan)))
                 visited[rows, s] = True
         except BaseException:
             # a failed promotion/dispatch must not leak this batch's pins
@@ -840,6 +871,11 @@ class StreamingKnnEngine:
         cur_d2 = np.full((n, k), np.inf, np.float32)
         cur_idx = np.full((n, k), -1, np.int32)
         q, lb, visited = handle.queries, handle.lb, handle.visited
+        plan = handle.plan
+        # recall plan: (c) shave the escalation margin, (d) never stall
+        # an escalation wave on a cold slab — skip it for recall instead
+        slack = float(plan.route_slack) if plan is not None else 0.0
+        skip_cold = plan is not None and plan.stream_skip_cold
         lb_safe = lb * (1.0 - self.cert_slack)
         reachable = np.isfinite(lb_safe)
         subs = handle.subs
@@ -850,15 +886,33 @@ class StreamingKnnEngine:
                     d2p, idxp = eng.complete_candidates(sub)
                     fold_candidates(cur_d2, cur_idx, rows, d2p, idxp, k)
                 r2 = cur_d2[:, k - 1].astype(np.float64)
-                need = (~visited) & reachable & (lb_safe <= r2[:, None])
+                need = (~visited) & reachable & (
+                    lb_safe <= r2[:, None] * (1.0 - slack))
                 if not need.any():
                     break
+                sids = [s for s in range(self.num_slabs) if need[:, s].any()]
+                if skip_cold:
+                    resident = set(self._pool.resident_slabs())
+                    cold = [s for s in sids if s not in resident]
+                    if cold:
+                        # the recall sacrifice (d) makes: these bounds
+                        # COULD beat the kth distance, but the slab is not
+                        # device-resident — give those pairs up, count the
+                        # skipped promotions, and warm the slabs async so
+                        # the NEXT batch finds them resident
+                        self.timers.count("stream_skipped_promotions",
+                                          len(cold))
+                        for s in cold:
+                            visited[need[:, s], s] = True
+                        self._pool.prefetch(cold)
+                        sids = [s for s in sids if s in resident]
+                        if not sids:
+                            continue
                 if wave == 1:
                     self.timers.count("stream_escalations",
                                       int(need.any(axis=1).sum()))
                 self.timers.count("stream_escalation_waves", 1)
                 wave += 1
-                sids = [s for s in range(self.num_slabs) if need[:, s].any()]
                 new = [s for s in sids if s not in handle.pinned]
                 if new:
                     self._pool.pin(new)
@@ -868,7 +922,10 @@ class StreamingKnnEngine:
                 for s in sids:
                     rows = np.nonzero(need[:, s])[0]
                     eng = self._pool.ensure(s)
-                    subs.append((s, rows, eng, eng.dispatch(q[rows])))
+                    subs.append((
+                        s, rows, eng,
+                        eng.dispatch(q[rows]) if plan is None
+                        else eng.dispatch(q[rows], plan=plan)))
                     visited[rows, s] = True
         finally:
             self._pool.unpin(handle.pinned)
@@ -906,8 +963,8 @@ class StreamingKnnEngine:
                 "emit='candidates' for the routed candidate-row contract")
         return self._complete_fold(handle)
 
-    def query(self, queries: np.ndarray):
-        return self.complete(self.dispatch(queries))
+    def query(self, queries: np.ndarray, plan=None):
+        return self.complete(self.dispatch(queries, plan=plan))
 
     def close(self) -> None:
         self._pool.close()
@@ -975,6 +1032,10 @@ class StreamingKnnEngine:
                 "escalations": self.timers.counter("stream_escalations"),
                 "escalation_waves":
                     self.timers.counter("stream_escalation_waves"),
+                # recall-SLO tier (stream_skip_cold): cold-slab promotions
+                # skipped for recall instead of stalled on
+                "skipped_promotions":
+                    self.timers.counter("stream_skipped_promotions"),
             },
             "timers": self.timers.report(),
         }

@@ -54,9 +54,16 @@ if [ "${SERVE_BENCH:-1}" != "0" ] && [ "$rc" -ne 124 ]; then
   # budget — gated on bitwise probe parity vs a fully-resident engine
   # (cold AND post-churn) plus a stream-stall-fraction ceiling (the
   # bounds-driven prefetcher must hide promotions under compute)
-  timeout -k 10 2400 python tools/serve_smoke.py --duration 2 --trials 3 \
+  # --recall-bench adds the recall-SLO tier section (recall_compare):
+  # every requested recall target measured against the exact engine's
+  # ids per workload shape over a clustered index — gated on measured
+  # recall >= the requested target on every workload, approx-tier q/s
+  # >= 3x exact on clustered (engine tier), the no-recall default path
+  # staying bitwise identical through the live server, and the
+  # exact:false / X-Knn-* / stats / metrics response contract
+  timeout -k 10 2700 python tools/serve_smoke.py --duration 2 --trials 3 \
       --locality-bench --multihost-bench --kernel-bench --routing-bench \
-      --chaos-bench --replica-bench --streaming-bench \
+      --chaos-bench --replica-bench --streaming-bench --recall-bench \
       --out BENCH_serve.json >/dev/null || { brc=$?; [ "$rc" -eq 0 ] && rc=$brc; }
 fi
 # the lskcheck gate blocks even when the tests pass (and never masks a

@@ -91,39 +91,66 @@ class _Client:
                 self._conn = None
 
     def post_batch(self, queries: np.ndarray, neighbors: bool,
-                   binary: bool):
-        """-> (status, degraded, retry_after_s|None). ``degraded`` is the
-        server's exactness flag for a 200 (the pod front end's degraded
-        partial answers under --on-host-loss degrade: ``"exact": false``
-        in JSON, ``X-Knn-Exact: 0`` in binary); ``retry_after_s`` echoes a
-        Retry-After header so the load loop can honor 503/429
-        backpressure instead of hammering a draining pod."""
+                   binary: bool, recall: float | None = None):
+        """-> (status, degraded, retry_after_s|None, tier|None).
+
+        ``degraded`` is the server's HOST-LOSS exactness flag for a 200
+        (the pod front end's degraded partial answers under
+        --on-host-loss degrade) — a recall-SLO approximate answer is NOT
+        degraded: it carries a plan (``recall_plan`` in JSON,
+        ``X-Knn-Recall-Plan`` in binary) and lands in ``tier`` instead.
+        ``recall`` attaches the request's recall-SLO target (JSON body
+        key; query string for binary — the octet codec's only option
+        channel); ``tier`` then reports the server's resolution:
+        ``{"exact": bool, "recall_estimated": float|None, "plan":
+        str|None}``. ``retry_after_s`` echoes a Retry-After header so the
+        load loop can honor 503/429 backpressure instead of hammering a
+        draining pod."""
+        tier = None
         if binary:
             # raw f32 xyz triples in, raw f32 distances out — the server's
             # octet-stream format. Skips both sides' JSON encode/decode, so
             # the client measures the engine, not the text codec (neighbors
             # ride the query string; only the JSON response carries them)
+            opts = [o for o in (
+                "neighbors=1" if neighbors else "",
+                f"recall={recall:g}" if recall is not None else "") if o]
             status, payload, headers = self._request(
-                "/knn" + ("?neighbors=1" if neighbors else ""),
+                "/knn" + ("?" + "&".join(opts) if opts else ""),
                 np.ascontiguousarray(queries, np.float32).tobytes(),
                 "application/octet-stream")
             degraded = False
             if status == 200:
                 np.frombuffer(payload, np.float32)
-                degraded = headers.get("X-Knn-Exact") == "0"
+                plan = headers.get("X-Knn-Recall-Plan")
+                degraded = (headers.get("X-Knn-Exact") == "0"
+                            and plan is None)
+                if recall is not None:
+                    est = headers.get("X-Knn-Recall-Estimated")
+                    tier = {"exact": headers.get("X-Knn-Exact") != "0",
+                            "recall_estimated": (float(est)
+                                                 if est is not None
+                                                 else None),
+                            "plan": plan}
         else:
+            body = {"queries": queries.tolist(), "neighbors": neighbors}
+            if recall is not None:
+                body["recall"] = recall
             status, payload, headers = self._request(
-                "/knn", json.dumps({"queries": queries.tolist(),
-                                    "neighbors": neighbors}).encode(),
-                "application/json")
+                "/knn", json.dumps(body).encode(), "application/json")
             obj = json.loads(payload.decode())
-            degraded = status == 200 and obj.get("exact") is False
+            degraded = (status == 200 and obj.get("exact") is False
+                        and "recall_plan" not in obj)
+            if status == 200 and recall is not None:
+                tier = {"exact": obj.get("exact") is not False,
+                        "recall_estimated": obj.get("recall_estimated"),
+                        "plan": obj.get("recall_plan")}
         ra = headers.get("Retry-After")
         try:
             retry_after_s = float(ra) if ra is not None else None
         except ValueError:
             retry_after_s = None
-        return status, degraded, retry_after_s
+        return status, degraded, retry_after_s, tier
 
 
 def _server_pipeline_stats(url: str, timeout_s: float) -> dict | None:
@@ -196,7 +223,8 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
              blobs: int = 16, blob_sigma: float = 0.02,
              sweep_period_s: float = 2.0,
              hosts: list[str] | None = None,
-             retry_after_cap_s: float = 1.0) -> dict:
+             retry_after_cap_s: float = 1.0,
+             recall: float | None = None) -> dict:
     """Drive the server; returns the JSON-able report (also the test API).
 
     ``qps > 0`` switches to open loop: the request schedule is fixed at
@@ -251,15 +279,20 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
     lock = threading.Lock()
     counts = {"ok": 0, "degraded": 0, "overload": 0, "deadline": 0,
               "unavailable": 0, "http_error": 0,
-              "net_error": 0, "rows_ok": 0, "sched_skipped": 0}
+              "net_error": 0, "rows_ok": 0, "sched_skipped": 0,
+              "approx": 0}
     status_counts: dict[str, int] = {}
+    #: recall-SLO accounting: per-plan approx counts and the server's
+    #: claimed recall_estimated distribution over the approx 200s
+    recall_plan_counts: dict[str, int] = {}
+    recall_est_counts: dict[str, int] = {}
     ep_counts = {u: {"requests": 0, "ok": 0, "errors": 0, "degraded": 0,
                      "rejected": 0}
                  for u in endpoints}
     stop_at = time.monotonic() + duration_s
 
     def account(endpoint: str, status: int, dt: float, rows: int,
-                degraded: bool = False):
+                degraded: bool = False, tier: dict | None = None):
         hist.record(dt)
         ep_hists[endpoint].record(dt)
         with lock:
@@ -272,6 +305,16 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
                 if degraded:
                     counts["degraded"] += 1
                     ep_counts[endpoint]["degraded"] += 1
+                if tier is not None and not tier["exact"]:
+                    counts["approx"] += 1
+                    plan = tier.get("plan") or "?"
+                    recall_plan_counts[plan] = (
+                        recall_plan_counts.get(plan, 0) + 1)
+                    est = tier.get("recall_estimated")
+                    if est is not None:
+                        key = f"{est:g}"
+                        recall_est_counts[key] = (
+                            recall_est_counts.get(key, 0) + 1)
             elif status == 429:
                 counts["overload"] += 1
             elif status == 503:
@@ -303,10 +346,10 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
         endpoint, client = pick_client()
         t0 = time.perf_counter()
         try:
-            status, degraded, retry_after = client.post_batch(
-                q, neighbors, binary)
+            status, degraded, retry_after, tier = client.post_batch(
+                q, neighbors, binary, recall=recall)
             account(endpoint, status, time.perf_counter() - t0,
-                    batch if status == 200 else 0, degraded)
+                    batch if status == 200 else 0, degraded, tier)
             if status in (429, 503) and retry_after:
                 # honor the server's backpressure, capped by the
                 # --retry-after-cap knob (an outage must not park workers
@@ -443,6 +486,22 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
                        if attempted else None),
         "degraded_rate": (round(counts["degraded"] / counts["ok"], 4)
                           if counts["ok"] else None),
+        # recall-SLO surface (only when a target was offered): the
+        # approx-tier share of the 200s, the q/s split by served tier,
+        # and the server's claimed recall_estimated / plan distributions
+        **({"recall": {
+            "target": recall,
+            "approx_requests": counts["approx"],
+            "exact_requests": counts["ok"] - counts["approx"],
+            "approx_share": (round(counts["approx"] / counts["ok"], 4)
+                             if counts["ok"] else None),
+            "qps_approx": round(counts["approx"] / elapsed, 2),
+            "qps_exact": round(
+                (counts["ok"] - counts["approx"]) / elapsed, 2),
+            "plan_counts": dict(sorted(recall_plan_counts.items())),
+            "recall_estimated_counts": dict(
+                sorted(recall_est_counts.items())),
+        }} if recall is not None else {}),
         "latency_seconds": lat,
         # None (JSON null) when nothing was measured — e.g. server down,
         # every request a net_error — keeping the report strict JSON
@@ -488,6 +547,13 @@ def main(argv=None) -> int:
     ap.add_argument("--sweep-period", type=float, default=2.0,
                     help="sweep: seconds per full diagonal traversal "
                          "(wrapping)")
+    ap.add_argument("--recall", type=float, default=None,
+                    help="attach this recall-SLO target to every request "
+                         "(JSON body key / binary query string); the "
+                         "report then splits q/s by served tier and "
+                         "carries the plan + recall_estimated "
+                         "distributions (docs/SERVING.md 'Recall-SLO "
+                         "tier')")
     ap.add_argument("--retry-after-cap", type=float, default=1.0,
                     help="max seconds a closed-loop worker honors a "
                          "Retry-After on 503/429 (default 1.0; raise for "
@@ -505,7 +571,8 @@ def main(argv=None) -> int:
                       workload=a.workload, blobs=a.blobs,
                       blob_sigma=a.blob_sigma,
                       sweep_period_s=a.sweep_period, hosts=hosts,
-                      retry_after_cap_s=a.retry_after_cap)
+                      retry_after_cap_s=a.retry_after_cap,
+                      recall=a.recall)
     text = json.dumps(report, indent=2)
     print(text)
     if a.out:
