@@ -118,6 +118,13 @@ answer over POST /shard_knn to the pod front end
                     bucket, and serves it — fingerprint-gated by the
                     front end before any query routes here
                     (docs/SERVING.md "Replication & slab handoff")
+  --wire M          auto | f32 (default auto). Host-side wire-codec
+                    capability: auto advertises the compressed codecs
+                    (q16 candidate rows, d16 slab transfer — served only
+                    when the peer asks; docs/SERVING.md "Wire formats");
+                    f32 advertises and serves only the uncompressed
+                    codec — the supported old-binary emulation for mixed
+                    pods, and the kill switch if a codec misbehaves
 """
 
 
@@ -148,7 +155,7 @@ def parse_serve_args(argv: list[str]) -> dict:
            "timeout_ms": 5000.0, "warmup": True, "timings": False,
            "verbose": False,
            "coordinator": None, "num_hosts": 1, "host_id": 0,
-           "routing": "off", "standby": False}
+           "routing": "off", "standby": False, "wire": "auto"}
     i = 0
     try:
         while i < len(argv):
@@ -209,6 +216,8 @@ def parse_serve_args(argv: list[str]) -> dict:
                 i += 1; opt["routing"] = argv[i]
             elif arg == "--standby":
                 opt["standby"] = True
+            elif arg == "--wire":
+                i += 1; opt["wire"] = argv[i]
             elif arg == "--no-warmup":
                 opt["warmup"] = False
             elif arg == "--timings":
@@ -226,6 +235,8 @@ def parse_serve_args(argv: list[str]) -> dict:
         usage("no k specified, or invalid k value")
     if opt["routing"] not in ("off", "bounds"):
         usage(f"--routing must be off or bounds, got '{opt['routing']}'")
+    if opt["wire"] not in ("auto", "f32"):
+        usage(f"--wire must be auto or f32, got '{opt['wire']}'")
     if opt["routing"] == "bounds" and opt["coordinator"]:
         usage("--routing bounds hosts are independent processes — they "
               "never join a global mesh, so --coordinator is a config "
@@ -288,6 +299,7 @@ def main(argv: list[str] | None = None) -> int:
         server = HostSliceServer((opt["host"], opt["port"]), None,
                                  routing="bounds",
                                  standby_config=standby_config,
+                                 wire=opt["wire"],
                                  verbose=opt["verbose"])
         host, port = server.server_address[:2]
         print(f"standby host on http://{host}:{port} — no slab adopted "
@@ -402,6 +414,7 @@ def main(argv: list[str] | None = None) -> int:
         server = HostSliceServer((opt["host"], opt["port"]), engine,
                                  routing=opt["routing"],
                                  seq_timeout_s=opt["seq_timeout_s"],
+                                 wire=opt["wire"],
                                  verbose=opt["verbose"])
         try:
             if opt["warmup"]:

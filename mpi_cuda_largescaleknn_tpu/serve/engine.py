@@ -1021,6 +1021,16 @@ class ResidentKnnEngine:
             d2, idx = out_d, out_i
         return d2, idx
 
+    def refetch_exact(self, queries):
+        """Survivor re-fetch hook (PR-17 quantized wire): exact f32
+        candidate rows for ``queries``, byte-equal to any earlier batch
+        that contained these rows. Candidate rows are batch-composition
+        INDEPENDENT — each row's top-k over this engine's points is a
+        function of the query row alone — which is the property the
+        ``?wire=x32`` re-fetch (and the routed escalation waves before
+        it) relies on: re-asking costs a round trip, never bits."""
+        return self.complete_candidates(self.dispatch(queries))
+
     def complete_slices(self, batch: _InFlightBatch):
         """Pod-mode ``complete``: fetch ONLY this process's addressable row
         slices of the pod-final answer.

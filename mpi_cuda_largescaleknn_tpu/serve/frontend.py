@@ -101,6 +101,7 @@ import json
 import threading
 import time
 import urllib.request
+import zlib
 from collections import Counter
 from concurrent.futures import ThreadPoolExecutor
 from http.server import ThreadingHTTPServer
@@ -130,6 +131,16 @@ from mpi_cuda_largescaleknn_tpu.serve.server import (
     parse_knn_body,
     recall_response_fields,
     slab_pool_prometheus_lines,
+)
+from mpi_cuda_largescaleknn_tpu.serve.wire import (
+    WireError,
+    WireNegotiator,
+    WireStats,
+    decode_candidates_q16,
+    encode_candidates_q16,
+    encode_slab_chunk,
+    frame_chunk,
+    wire_caps,
 )
 from mpi_cuda_largescaleknn_tpu.utils.math import aabb_lower_bound_dist2
 
@@ -168,10 +179,17 @@ class HostSliceServer(ThreadingHTTPServer):
                  seq_timeout_s: float | None = None,
                  faults: FaultInjector | None = None,
                  standby_config: dict | None = None,
+                 wire: str = "auto",
                  verbose: bool = False):
         if routing not in ("off", "bounds"):
             raise ValueError(f"routing must be 'off' or 'bounds', "
                              f"got {routing!r}")
+        if wire not in ("auto", "f32"):
+            raise ValueError(f"host wire mode must be 'auto' or 'f32', "
+                             f"got {wire!r}")
+        #: "f32" = advertise and serve only the uncompressed codecs (the
+        #: old-binary emulation / codec kill switch; serve_main --wire)
+        self.wire_mode = wire
         if seq_timeout_s is not None:
             if seq_timeout_s <= 0:
                 raise ValueError(f"seq_timeout_s must be > 0, "
@@ -210,6 +228,9 @@ class HostSliceServer(ThreadingHTTPServer):
         self.verbose = verbose
         self._loop_entered = False
         self.metrics = ServingMetrics()
+        #: per-(path, codec) wire byte accounting (serve/wire.py) behind
+        #: /stats wire_traffic and the /metrics knn_wire_* families
+        self.wire_stats = WireStats()
         self._seq_cond = threading.Condition()
         self.next_seq: guarded_by("_seq_cond") = 0
         self._adopt_lock = threading.Lock()
@@ -385,6 +406,7 @@ class _HostHandler(JsonHttpHandler):
             elif path == "/stats":
                 self._send_json(200, {"routing": srv.routing,
                                       "standby": True, "adopt": snap,
+                                      "wire": wire_caps(srv.wire_mode),
                                       "server": srv.metrics.snapshot()})
             elif path == "/metrics":
                 self._send(200, "# TYPE knn_ready gauge\nknn_ready 0\n"
@@ -406,9 +428,14 @@ class _HostHandler(JsonHttpHandler):
                 body["adopt"] = adopt
             self._send_json(200 if srv.ready else 503, body)
         elif path == "/stats":
+            # wire caps live at the ROOT (not in the engine block), so
+            # advertising a new codec can never shift the replica
+            # fingerprint and wedge mixed old/new pod handoffs
             self._send_json(200, {"engine": srv.engine.stats(),
                                   "routing": srv.routing,
                                   "next_seq": srv.next_seq_snapshot(),
+                                  "wire": wire_caps(srv.wire_mode),
+                                  "wire_traffic": srv.wire_stats.snapshot(),
                                   "server": srv.metrics.snapshot()})
         elif path == "/slab_rows":
             # slab handoff's pull path: a standby adopting this host's
@@ -420,10 +447,16 @@ class _HostHandler(JsonHttpHandler):
                     "error": "no host-side slab rows on this server "
                              "(routed slab hosts only)"})
                 return
+            qs = parse_qs(urlparse(self.path).query)
+            if "wire" in qs:
+                self._send_slab_stream(srv, pts, qs)
+                return
+            # legacy puller (no ?wire=): the pre-codec single-shot body.
             # zero-copy: the slab is 1/H of the index and the pull lands
             # exactly while this host absorbs the dead replica's load —
             # a .tobytes() here would transiently double the slab's RAM
             body = memoryview(np.ascontiguousarray(pts, "<f4")).cast("B")
+            srv.wire_stats.add("slab_rows", "f32", len(body), len(pts))
             self._send(200, body, "application/octet-stream",
                        extra=[("X-Knn-Rows", str(len(pts))),
                               ("X-Knn-Dim", str(srv.engine.dim)),
@@ -455,10 +488,62 @@ class _HostHandler(JsonHttpHandler):
             # (serve_main --routing bounds --num-slabs): surface its
             # tiered-pool counters with the single-host server's renderer
             lines += slab_pool_prometheus_lines(e)
+            lines += srv.wire_stats.prometheus_lines()
             self._send(200, ("\n".join(lines) + "\n").encode(),
                        "text/plain; version=0.0.4")
         else:
             self._send_json(404, {"error": f"no such path {path}"})
+
+    #: rows per /slab_rows stream chunk: ~768 KiB of f32 at dim=3 — big
+    #: enough to amortize framing, small enough that the transient copy
+    #: is a rounding error next to the slab itself
+    slab_chunk_rows = 65536
+
+    def _send_slab_stream(self, srv, pts, qs):
+        """New-style ``/slab_rows?wire=d16|f32``: chunk-streamed with the
+        serve/wire.py app framing. Each chunk is encoded (d16 delta codec
+        or raw f32) and written immediately — the peak transient is one
+        chunk, never a second copy of the slab. The fingerprint header is
+        the crc32 of the RAW f32 bytes; the puller verifies it after
+        decode, so a torn or corrupt transfer can never materialize."""
+        codec = ("d16" if qs.get("wire", ["f32"])[0] == "d16"
+                 and self.server.wire_mode != "f32" else "f32")
+        pts = np.ascontiguousarray(pts, "<f4")
+        try:
+            begin = int(qs.get("begin", ["0"])[0])
+            end = int(qs.get("end", [str(len(pts))])[0])
+            if not (0 <= begin <= end <= len(pts)):
+                raise ValueError(f"row range [{begin}, {end}) outside "
+                                 f"[0, {len(pts)})")
+        except ValueError as e:
+            self._send_json(400, {"error": f"bad slab range: {e}"})
+            return
+        sel = pts[begin:end]
+        crc = zlib.crc32(memoryview(sel).cast("B"))
+        self._start_chunked(
+            200, "application/octet-stream",
+            extra=[("X-Knn-Rows", str(len(sel))),
+                   ("X-Knn-Dim", str(srv.engine.dim)),
+                   ("X-Knn-Row-Offset", str(srv.engine.id_offset + begin)),
+                   ("X-Knn-Wire", codec),
+                   ("X-Knn-Fingerprint", f"{crc:08x}")])
+        sent = 0
+        step = self.slab_chunk_rows
+        try:
+            for i in range(0, len(sel), step):
+                sub = sel[i:i + step]
+                if codec == "d16":
+                    payload = encode_slab_chunk(sub)
+                else:
+                    payload = b"\x00" + sub.tobytes()
+                self._write_chunk(frame_chunk(len(sub), payload))
+                sent += 8 + len(payload)
+            self._end_chunked()
+        except (BrokenPipeError, ConnectionResetError):
+            # puller went away mid-stream (its torn-transfer detection
+            # handles the partial body); nothing for us to salvage
+            self.close_connection = True
+        srv.wire_stats.add("slab_rows", codec, sent, len(sel))
 
     def do_POST(self):
         srv: HostSliceServer = self.server
@@ -537,7 +622,14 @@ class _HostHandler(JsonHttpHandler):
             return
         try:
             if srv.routing == "bounds":
-                d2, idx = srv.run_routed(q)
+                wire_req = parse_qs(parsed.query).get("wire", ["f32"])[0]
+                if wire_req == "x32":
+                    # survivor re-fetch: the engine hook re-derives the
+                    # exact rows (batch-composition independent, so they
+                    # are byte-equal to the quantized wave's)
+                    d2, idx = srv.engine.refetch_exact(q)
+                else:
+                    d2, idx = srv.run_routed(q)
             else:
                 rows, dists, nbrs = srv.run_in_order(seq, q)
         except TimeoutError as e:
@@ -555,11 +647,37 @@ class _HostHandler(JsonHttpHandler):
         if srv.routing == "bounds":
             srv.metrics.inc("knn_rows_total", len(q))
             srv.metrics.inc("knn_routed_rows_total", len(q))
-            body = (np.ascontiguousarray(d2, "<f4").tobytes()
-                    + np.ascontiguousarray(idx, "<i4").tobytes())
+            # negotiated wire codec (serve/wire.py): ?wire=q16 compresses
+            # the candidate rows (upper-bound decode, exact re-merge on
+            # the frontend); ?wire=x32 is the survivor re-fetch variant —
+            # exact d2 only, ids implied by the engine's determinism. An
+            # old frontend sends no ?wire= and gets the f32 body with no
+            # X-Knn-Wire header, byte-identical to the pre-codec binary.
+            codec, extra = "f32", []
+            d2 = np.ascontiguousarray(d2, "<f4")
+            idx = np.ascontiguousarray(idx, "<i4")
+            if srv.wire_mode == "f32":
+                # f32-only host (old-binary emulation): any ?wire= ask
+                # degrades to the uncompressed body with no X-Knn-Wire
+                # header — the frontend's negotiated fallback, never an
+                # error (an x32 refetch still gets exact d2 this way)
+                body = d2.tobytes() + idx.tobytes()
+            elif wire_req == "x32":
+                codec, body = "x32", d2.tobytes()
+            elif wire_req == "q16":
+                body = encode_candidates_q16(d2, idx)
+                if body is not None:
+                    codec = "q16"
+                else:
+                    body = d2.tobytes() + idx.tobytes()
+            else:
+                body = d2.tobytes() + idx.tobytes()
+            if codec != "f32":
+                extra = [("X-Knn-Wire", codec)]
+            srv.wire_stats.add("candidates", codec, len(body), len(q))
             self._send(200, body, "application/octet-stream",
                        extra=[("X-Knn-Rows", str(len(q))),
-                              ("X-Knn-K", str(srv.engine.k))])
+                              ("X-Knn-K", str(srv.engine.k))] + extra)
             return
         srv.metrics.inc("knn_rows_total", len(rows))
         body = (np.ascontiguousarray(rows, "<i4").tobytes()
@@ -978,7 +1096,8 @@ class RoutedPodFanout(PodFanout):
                  request_timeout_s: float | None = None,
                  health_config: dict | None = None,
                  replica_groups: list[dict] | None = None,
-                 spread_seed: int = 0):
+                 spread_seed: int = 0, wire: str = "auto",
+                 wire_host_caps: dict | None = None):
         from mpi_cuda_largescaleknn_tpu.serve.replica import ReplicaSet
 
         super().__init__(host_urls, k=k, max_batch=max_batch,
@@ -986,6 +1105,16 @@ class RoutedPodFanout(PodFanout):
                          retries=retries, retry_backoff_s=retry_backoff_s,
                          request_timeout_s=request_timeout_s,
                          health_config=health_config)
+        #: per-host negotiated wire codec (serve/wire.py): ``wire`` is the
+        #: frontend knob (auto|f32|q16); caps come from each host's /stats
+        #: root as scraped at startup (pod_config_from_hosts) and on
+        #: adoption (ReplicaManager) — a host with no caps negotiates f32,
+        #: so mixed old/new pods interop without config
+        self.negotiator = WireNegotiator(
+            "f32" if wire == "f32" else ("q16" if wire == "q16" else "auto"))
+        for url, caps in (wire_host_caps or {}).items():
+            self.negotiator.set_caps(url, caps)
+        self.wire_stats = WireStats()
         #: slab -> replica-endpoint-group table (serve/replica.py): every
         #: routing decision is per SLAB; a healthy member is picked per
         #: sub-batch. None = the trivial R=1 set (one slab per endpoint),
@@ -1005,6 +1134,13 @@ class RoutedPodFanout(PodFanout):
         self.degraded_rows: guarded_by("_lock") = 0
         self.host_loss_events: guarded_by("_lock") = 0
         self.hosts_per_query: guarded_by("_lock") = Counter()
+        # quantized-exchange resolution accounting: how often the exact
+        # re-merge was served verbatim (provably unchanged by re-fetch),
+        # re-fetched, or degraded because every re-fetch replica failed
+        self.wire_verbatim_rows: guarded_by("_lock") = 0
+        self.wire_refetch_rows: guarded_by("_lock") = 0
+        self.wire_refetch_posts: guarded_by("_lock") = 0
+        self.wire_refetch_failed_rows: guarded_by("_lock") = 0
         for ep in self.endpoints:
             ep.routed_rows = 0
 
@@ -1023,16 +1159,25 @@ class RoutedPodFanout(PodFanout):
 
     # ------------------------------------------------------------- transport
 
-    def _route_once(self, ep: _HostEndpoint, body: bytes, m: int):
+    def _route_once(self, ep: _HostEndpoint, body: bytes, m: int,
+                    codec: str = "f32"):
         """ONE POST attempt to one routed host; parse its candidate rows.
-        Returns (d2 f32[m,k], idx i32[m,k], seconds); raises
-        ``HostCallError`` classified transient (5xx, timeouts, connect
-        errors, torn payloads — worth a retry) or not (4xx config)."""
+        Returns ``(d2, d2_lo, idx, seconds, codec)`` where ``codec`` is
+        what the RESPONSE actually carried (the X-Knn-Wire header — a host
+        that ignores or declines ``?wire=q16`` answers plain f32, so a
+        mismatch is a clean fallback, never a decode error). For f32 the
+        bounds coincide (``d2_lo is d2``); for q16 they bracket the true
+        distance with the anchor (kth) slot exact; for x32 (the survivor
+        re-fetch variant) ``idx`` is None — ids are implied by the
+        engine's determinism. Raises ``HostCallError`` classified
+        transient (5xx, timeouts, connect errors, torn payloads — worth a
+        retry) or not (4xx config)."""
         k = self.k
         t0 = time.perf_counter()
+        qs = f"?wire={codec}" if codec != "f32" else ""
         try:
             conn = self._conn(ep)
-            conn.request("POST", f"{ep.prefix}/route_knn", body=body,
+            conn.request("POST", f"{ep.prefix}/route_knn{qs}", body=body,
                          headers={"Content-Type":
                                   "application/octet-stream"})
             resp = conn.getresponse()
@@ -1044,14 +1189,36 @@ class RoutedPodFanout(PodFanout):
                     transient=resp.status >= 500)
             got = int(resp.getheader("X-Knn-Rows", "-1"))
             kk = int(resp.getheader("X-Knn-K", str(k)))
-            if got != m or kk != k or len(payload) != 8 * m * k:
+            wire_got = resp.getheader("X-Knn-Wire") or "f32"
+            if got != m or kk != k:
                 raise HostCallError(
                     f"host {ep.url} partial malformed: rows={got} (want "
-                    f"{m}) k={kk} bytes={len(payload)}")
-            d2 = np.frombuffer(payload, "<f4",
-                               count=m * k).reshape(m, k)
-            idx = np.frombuffer(payload, "<i4", count=m * k,
-                                offset=4 * m * k).reshape(m, k)
+                    f"{m}) k={kk}")
+            if wire_got == "q16":
+                try:
+                    d2, d2_lo, idx = decode_candidates_q16(payload, m, k)
+                except WireError as e:
+                    raise HostCallError(
+                        f"host {ep.url} q16 partial undecodable: {e}") \
+                        from e
+            elif wire_got == "x32":
+                if len(payload) != 4 * m * k:
+                    raise HostCallError(
+                        f"host {ep.url} x32 partial malformed: "
+                        f"bytes={len(payload)}")
+                d2 = np.frombuffer(payload, "<f4",
+                                   count=m * k).reshape(m, k)
+                d2_lo, idx = d2, None
+            else:
+                if len(payload) != 8 * m * k:
+                    raise HostCallError(
+                        f"host {ep.url} partial malformed: rows={got} "
+                        f"(want {m}) k={kk} bytes={len(payload)}")
+                d2 = np.frombuffer(payload, "<f4",
+                                   count=m * k).reshape(m, k)
+                idx = np.frombuffer(payload, "<i4", count=m * k,
+                                    offset=4 * m * k).reshape(m, k)
+                d2_lo = d2
         except HostCallError:
             self._drop_conn(ep)
             raise
@@ -1060,9 +1227,11 @@ class RoutedPodFanout(PodFanout):
             raise HostCallError(
                 f"host {ep.url} unreachable: "
                 f"{type(e).__name__}: {e}") from e
-        return d2, idx, time.perf_counter() - t0
+        self.wire_stats.add("candidates", wire_got, len(payload), m)
+        return d2, d2_lo, idx, time.perf_counter() - t0, wire_got
 
-    def _post_route(self, ep: _HostEndpoint, body: bytes, m: int):
+    def _post_route(self, ep: _HostEndpoint, body: bytes, m: int,
+                    codec: str = "f32"):
         """`_route_once` with bounded retries + deterministic backoff on
         TRANSIENT failures (the /route_knn contract is idempotent — a
         routed sub-batch is a pure read, so re-sending it is always safe,
@@ -1070,7 +1239,7 @@ class RoutedPodFanout(PodFanout):
         attempt = 0
         while True:
             try:
-                return self._route_once(ep, body, m)
+                return self._route_once(ep, body, m, codec)
             except HostCallError as e:
                 if not e.transient or attempt >= self.retries:
                     raise
@@ -1098,10 +1267,11 @@ class RoutedPodFanout(PodFanout):
             if ep_i is None:
                 continue
             body = np.ascontiguousarray(q[rows], "<f4").tobytes()
+            codec = self.negotiator.codec_for(self.endpoints[ep_i].url)
             futs.append((s, ep_i, rows,
                          self._pool.submit(self._post_route,
                                            self.endpoints[ep_i], body,
-                                           len(rows))))
+                                           len(rows), codec)))
         return futs
 
     # ---------------------------------------------------------- query_fn API
@@ -1199,11 +1369,15 @@ class RoutedPodFanout(PodFanout):
         # forever — once over budget it is unusable for THIS batch; a
         # slab with no usable member resolves per the on-host-loss policy
         batch_failures: dict[int, int] = {}
+        # every successful sub-batch is retained: quantized (q16) partials
+        # fold as UPPER bounds — sound for the escalation radius — and the
+        # retained rows + lower bounds drive the exact re-merge afterwards
+        contribs: list[tuple] = []
         while True:
             for s, ep_i, rows, fut in futs:
                 ep = self.endpoints[ep_i]
                 try:
-                    d2, idx, dt = fut.result()
+                    d2, d2_lo, idx, dt, codec = fut.result()
                 except HostCallError as e:
                     with self._lock:
                         ep.errors += 1
@@ -1224,6 +1398,11 @@ class RoutedPodFanout(PodFanout):
                 ep.health.note_success()
                 dts.append(dt)
                 fold_candidates(cur_d2, cur_idx, rows, d2, idx, k)
+                contribs.append((s, ep_i, rows, d2, d2_lo, idx, codec))
+            # quantized partials fold upper bounds, so this radius is >=
+            # the exact fold's on the same visited set: escalation can
+            # only widen — certification never skips a host a
+            # full-precision fold would have visited
             r2 = cur_d2[:, k - 1].astype(np.float64)
             need = (~visited) & reachable & (
                 lb_safe <= r2[:, None] * (1.0 - slack))
@@ -1248,13 +1427,33 @@ class RoutedPodFanout(PodFanout):
                 break
             for s, _ep_i, rows, _f in futs:
                 visited[rows, s] = True
+        # exact re-merge: with any quantized contribution in play, the
+        # conservative fold's bits are NOT the served answer — resolve
+        # each query to the f32-identical row (verbatim when provable,
+        # x32 re-fetch + one-shot exact fold otherwise). A pure-f32 batch
+        # skips this entirely: the fold above IS the pre-codec path.
+        if any(c[6] == "q16" for c in contribs):
+            r2_f32, cur_idx, refetch_failed = self._resolve_quantized(
+                q, n, contribs, cur_d2, cur_idx)
+            r2 = r2_f32.astype(np.float64)
+        else:
+            refetch_failed = None
+            r2_f32 = cur_d2[:, k - 1]
         # certification closed over the AVAILABLE slabs; whatever remains
         # uncertified points at fully-down slabs — those queries are
         # inexact (judged under the plan's slack: the approximate tier
-        # flags its rows inexact at the response layer regardless)
+        # flags its rows inexact at the response layer regardless). The
+        # exact radius is <= the conservative loop radius, so this final
+        # check can only shrink the uncertified set — exact flags match
+        # an f32-negotiated pod's bit for bit.
         uncertified = (~visited) & reachable & (
             lb_safe <= r2[:, None] * (1.0 - slack))
         exact = ~uncertified.any(axis=1)
+        if refetch_failed is not None:
+            # every replica of a quantized contributor refused the exact
+            # re-fetch: those rows serve the conservative fold, flagged
+            # inexact — the same honesty contract as a lost slab
+            exact &= ~refetch_failed
         with self._lock:
             self.batches += 1
             if not exact.all():
@@ -1268,7 +1467,130 @@ class RoutedPodFanout(PodFanout):
                 self.timers.hist("fanout_straggler_seconds").record(spread)
         self.timers.hist("fanout_batch_seconds").record(
             time.perf_counter() - handle["t0"])
-        return np.sqrt(cur_d2[:, k - 1]), cur_idx, exact
+        return np.sqrt(r2_f32), cur_idx, exact
+
+    # -------------------------------------------------- exact re-merge (q16)
+
+    def _resolve_quantized(self, q, n, contribs, cur_d2, cur_idx):
+        """Resolve the batch to the f32-identical served rows after a
+        conservative (upper-bound) fold. Per query:
+
+        - ONE contribution: its transmitted row verbatim — the ids ride
+          the wire exactly and the kth slot (anchor / pad) is bit-exact,
+          so the served pair needs no re-fetch.
+        - several: serve the smallest-kth contribution verbatim when
+          every OTHER contribution's smallest lower bound strictly
+          exceeds that kth (``lo <= true d2``, so none of their
+          candidates can enter the merged top-k — ties included, the
+          inequality is strict); otherwise re-fetch exact distances for
+          the quantized contributions (``?wire=x32`` — ids are implied by
+          the engines' determinism) and run ONE exact fold over all of
+          the query's rows, which equals the incremental f32 fold bit
+          for bit (the merge is a total order over unique (d2, id)).
+
+        Returns ``(kth_d2 f32[n], idx i32[n, k], refetch_failed bool[n])``
+        — failed rows keep the conservative fold and are flagged by the
+        caller."""
+        k = self.k
+        by_q: list[list] = [[] for _ in range(n)]
+        for ci, c in enumerate(contribs):
+            for j, qi in enumerate(c[2].tolist()):
+                by_q[qi].append((ci, j))
+        out_d2 = cur_d2[:, k - 1].copy()
+        out_idx = cur_idx.copy()
+        verbatim_rows = 0
+        merge_q: list[int] = []
+        refetch: dict[int, list[int]] = {}
+        for qi in range(n):
+            cl = by_q[qi]
+            if not cl:
+                continue  # unvisited everywhere — the host-loss path
+            if len(cl) > 1:
+                kths = [float(contribs[ci][3][j, k - 1]) for ci, j in cl]
+                b = int(np.argmin(kths))
+                if not all(float(contribs[ci][4][j, 0]) > kths[b]
+                           for t, (ci, j) in enumerate(cl) if t != b):
+                    merge_q.append(qi)
+                    for ci, j in cl:
+                        if contribs[ci][6] == "q16":
+                            refetch.setdefault(ci, []).append(j)
+                    continue
+            else:
+                b = 0
+            ci, j = cl[b]
+            out_d2[qi] = contribs[ci][3][j, k - 1]
+            out_idx[qi] = contribs[ci][5][j]
+            verbatim_rows += 1
+        failed = np.zeros(n, bool)
+        failed_ci: set[int] = set()
+        if merge_q:
+            jobs = {}
+            for ci, js in refetch.items():
+                s, ep_i, rows = contribs[ci][0], contribs[ci][1], \
+                    contribs[ci][2]
+                sub = np.asarray(js, np.int64)
+                jobs[ci] = (sub, self._pool.submit(
+                    self._refetch_exact, s, ep_i, q, rows[sub]))
+            for ci, (sub, fut) in jobs.items():
+                d2x = fut.result()
+                if d2x is None:
+                    failed_ci.add(ci)
+                else:
+                    # overwrite the decoded upper bounds with exact f32
+                    # (q16 decode owns its arrays — always writeable)
+                    contribs[ci][3][sub] = d2x
+            init_d2 = np.full(k, np.inf, np.float32)
+            init_idx = np.full(k, -1, np.int32)
+            for qi in merge_q:
+                cl = by_q[qi]
+                if any(ci in failed_ci for ci, _j in cl):
+                    failed[qi] = True  # conservative row already out_*
+                    continue
+                cat_d2 = np.concatenate(
+                    [init_d2] + [contribs[ci][3][j] for ci, j in cl])
+                cat_idx = np.concatenate(
+                    [init_idx] + [contribs[ci][5][j] for ci, j in cl])
+                order = np.lexsort((cat_idx, cat_d2))[:k]
+                out_d2[qi] = cat_d2[order[k - 1]]
+                out_idx[qi] = cat_idx[order]
+        with self._lock:
+            self.wire_verbatim_rows += verbatim_rows
+            self.wire_refetch_rows += sum(
+                len(sub) for sub, _f in jobs.values()) if merge_q else 0
+            self.wire_refetch_posts += len(refetch) if merge_q else 0
+            self.wire_refetch_failed_rows += int(failed.sum())
+        return out_d2, out_idx, failed
+
+    def _refetch_exact(self, s, ep_i, q, rows):
+        """Exact-distance re-fetch for the fold survivors of one
+        quantized sub-batch: ``?wire=x32`` re-poses the same query rows
+        (a pure idempotent read) to the SAME endpoint; the response is
+        d2 only — ids are implied because the engine is deterministic
+        and batch-composition independent (the property every escalation
+        wave already relies on). When that replica fails its retries,
+        any other usable replica of the slab answers instead (members
+        are byte-interchangeable by the fingerprint gate; an f32-only
+        member simply answers full f32, which carries exact d2 too).
+        Returns f32[len(rows), k] or None when the whole slab is out."""
+        body = np.ascontiguousarray(q[rows], "<f4").tobytes()
+        tried: dict[int, int] = {}
+        while True:
+            ep = self.endpoints[ep_i]
+            try:
+                d2, _lo, _idx, _dt, _codec = self._post_route(
+                    ep, body, len(rows), "x32")
+                return d2
+            except HostCallError as e:
+                with self._lock:
+                    ep.errors += 1
+                    ep.last_error = str(e)
+                ep.health.note_failure(str(e))
+                tried[ep_i] = self.retries + 1  # over budget: exclude
+                nxt = self.replicas.pick(s, penalties=tried,
+                                         budget=self.retries)
+                if nxt is None:
+                    return None
+                ep_i = nxt
 
     # ------------------------------------------------------------------ admin
 
@@ -1293,6 +1615,14 @@ class RoutedPodFanout(PodFanout):
                 # replication surface: per-slab member/live table + the
                 # spread counters (how picks distributed across replicas)
                 "replicas": replicas,
+            }
+            s["wire"] = {
+                **self.negotiator.snapshot(),
+                "traffic": self.wire_stats.snapshot(),
+                "verbatim_rows": self.wire_verbatim_rows,
+                "refetch_rows": self.wire_refetch_rows,
+                "refetch_posts": self.wire_refetch_posts,
+                "refetch_failed_rows": self.wire_refetch_failed_rows,
             }
         return s
 
@@ -1560,6 +1890,12 @@ class _FrontendHandler(JsonHttpHandler):
                     "# TYPE knn_handoff_seconds_total counter",
                     f"knn_handoff_seconds_total "
                     f"{handoff['handoff_seconds_total']}"]
+        # quantized wire exchange: bytes/rows per (path, codec) — the
+        # same families the hosts export, so a scrape sees both ends
+        # (routed fan-out only; the replicate pod ships no partials)
+        wire_stats = getattr(srv.fanout, "wire_stats", None)
+        if wire_stats is not None:
+            lines += wire_stats.prometheus_lines()
         # recall-SLO tier: exact/approx split + recall_estimated histogram
         lines += srv.metrics.recall_prometheus_lines()
         lines += srv.metrics.latency.prometheus_lines(
@@ -1777,8 +2113,12 @@ def pod_config_from_hosts(host_urls: list[str],
         )
 
         grouped = group_routed_hosts(host_urls, stats, fingerprints)
+        # wire caps come from the /stats ROOT (an old binary has none →
+        # f32), keyed by url so the negotiator can resolve per endpoint
+        caps = {url: s.get("wire") for url, s in zip(host_urls, raw)}
         return {"routing": "bounds",
                 "host_urls": grouped["host_urls"],
+                "wire_host_caps": caps,
                 "fingerprints": fingerprints,
                 "replica_groups": grouped["slabs"],
                 "slab_fingerprints": grouped["slab_fingerprints"],
@@ -1837,7 +2177,7 @@ def build_frontend(host_urls: list[str], *, host: str = "127.0.0.1",
                    health_config: dict | None = None,
                    start_monitor: bool = True,
                    standbys: list[str] | None = None,
-                   handoff_floor: int = 1,
+                   handoff_floor: int = 1, wire: str = "auto",
                    verbose: bool = False) -> FrontendServer:
     """Validate the pod and construct (but do not start) a FrontendServer;
     ``port=0`` picks a free port (``server.server_address[1]``).
@@ -1852,7 +2192,12 @@ def build_frontend(host_urls: list[str], *, host: str = "127.0.0.1",
     down); ``standbys`` lists warm ``--standby`` hosts the monitor's
     replica manager directs to adopt a slab whose live-replica count
     falls below ``handoff_floor`` (docs/SERVING.md "Replication & slab
-    handoff")."""
+    handoff"). ``wire`` picks the candidate-exchange codec policy
+    (routed pods): "auto" negotiates the compressed q16 exchange with
+    every capable host (exact f32 re-merge keeps served bits identical),
+    "f32" forces the uncompressed wire everywhere, "q16" is auto said
+    explicitly (a host without the cap still falls back to f32 — never
+    an error). See docs/SERVING.md "Wire formats & negotiation"."""
     from mpi_cuda_largescaleknn_tpu.serve.replica import ReplicaManager
 
     cfg = pod_config_from_hosts(host_urls, routing=routing)
@@ -1866,7 +2211,8 @@ def build_frontend(host_urls: list[str], *, host: str = "127.0.0.1",
             bounds=table, timeout_s=timeout_s, dim=cfg["dim"],
             retries=retries, retry_backoff_s=retry_backoff_s,
             request_timeout_s=request_timeout_s, health_config=hc,
-            replica_groups=cfg["replica_groups"])
+            replica_groups=cfg["replica_groups"], wire=wire,
+            wire_host_caps=cfg.get("wire_host_caps"))
     else:
         if standbys:
             raise ValueError("standby hosts (slab handoff) apply to "
@@ -1944,6 +2290,12 @@ FRONTEND_FLAGS = """
                     (default 1 = hand off only when a slab is fully
                     down; R with --handoff-floor R keeps full replication
                     through any single loss)
+  --wire M          auto | f32 | q16 (default auto): candidate-exchange
+                    codec policy for routed pods — auto negotiates the
+                    compressed q16 wire per host (served bits stay
+                    identical: exact f32 re-merge), f32 forces the
+                    uncompressed exchange (docs/SERVING.md "Wire formats
+                    & negotiation")
   --verbose         log each HTTP request to stderr
 """
 
@@ -1959,7 +2311,7 @@ def main(argv: list[str] | None = None) -> int:
            "on_host_loss": "fail", "retries": 2,
            "retry_backoff_ms": 50.0, "request_timeout_ms": 0.0,
            "probe_interval_s": 5.0, "fail_threshold": 3,
-           "standbys": "", "handoff_floor": 1,
+           "standbys": "", "handoff_floor": 1, "wire": "auto",
            "verbose": False}
     i = 0
     try:
@@ -1999,6 +2351,8 @@ def main(argv: list[str] | None = None) -> int:
                 i += 1; opt["standbys"] = args[i]
             elif a == "--handoff-floor":
                 i += 1; opt["handoff_floor"] = int(args[i])
+            elif a == "--wire":
+                i += 1; opt["wire"] = args[i]
             elif a == "--verbose":
                 opt["verbose"] = True
             else:
@@ -2028,7 +2382,8 @@ def main(argv: list[str] | None = None) -> int:
         probe_interval_s=opt["probe_interval_s"],
         fail_threshold=opt["fail_threshold"],
         standbys=[s for s in opt["standbys"].split(",") if s],
-        handoff_floor=opt["handoff_floor"], verbose=opt["verbose"])
+        handoff_floor=opt["handoff_floor"], wire=opt["wire"],
+        verbose=opt["verbose"])
     server.ready = True
     h, p = server.server_address[:2]
     mode = getattr(server.fanout, "routing_mode", "off")

@@ -61,6 +61,11 @@ from mpi_cuda_largescaleknn_tpu.serve.health import (
     STATE_CODE,
     host_fingerprint,
 )
+from mpi_cuda_largescaleknn_tpu.serve.wire import (
+    WireError,
+    decode_slab_chunk,
+    read_frames,
+)
 
 # ------------------------------------------------------------- replica set
 
@@ -272,22 +277,80 @@ def group_routed_hosts(host_urls: list[str], stats: list[dict],
 # ------------------------------------------------------------ slab transfer
 
 
-def pull_slab_rows(url: str, *, timeout_s: float = 120.0):
+def pull_slab_rows(url: str, *, timeout_s: float = 120.0,
+                   wire: str = "d16", begin: int | None = None,
+                   end: int | None = None,
+                   throttle_bps: float | None = None):
     """Fetch a surviving replica's host-side slab rows
-    (``GET /slab_rows`` — raw little-endian f32, row offset and dim in
-    headers). Returns ``(points f32[n, dim], row_offset)``; raises on a
-    torn transfer (short body / missing headers) so a half-copied slab
-    can never be adopted."""
-    with urllib.request.urlopen(url.rstrip("/") + "/slab_rows",
+    (``GET /slab_rows``). Returns ``(points f32[n, dim], row_offset)``;
+    raises on a torn transfer (short body / frame, fingerprint mismatch)
+    so a half-copied or corrupt slab can never be adopted.
+
+    ``wire`` asks for the chunk-streamed codec path (``d16`` delta codec
+    or chunked ``f32``); an OLD host ignores the query string and answers
+    the legacy single-shot body with no ``X-Knn-Wire`` header — the
+    response header, not the request, selects the parse, so mixed pods
+    interop with zero config. New-style responses are verified against
+    the host's crc32 fingerprint of the raw f32 bytes after decode (the
+    d16 transform is lossless; this catches torn/corrupt transport).
+    ``begin``/``end`` pull a row sub-range (cold-tier reads);
+    ``throttle_bps`` paces the pull to a byte budget (bench use:
+    emulated DCN bandwidth — decode overlaps the pacing sleep exactly
+    like real transfer overlaps decode)."""
+    q = [("wire", wire)] if wire in ("d16", "f32") else []
+    if begin is not None:
+        q.append(("begin", str(int(begin))))
+    if end is not None:
+        q.append(("end", str(int(end))))
+    qs = ("?" + "&".join(f"{k}={v}" for k, v in q)) if q else ""
+    t0 = time.perf_counter()
+    with urllib.request.urlopen(url.rstrip("/") + "/slab_rows" + qs,
                                 timeout=timeout_s) as r:
-        payload = r.read()
         rows = int(r.headers.get("X-Knn-Rows", "-1"))
         dim = int(r.headers.get("X-Knn-Dim", "0"))
         off = int(r.headers.get("X-Knn-Row-Offset", "-1"))
-    if rows < 0 or off < 0 or dim < 1 or len(payload) != 4 * rows * dim:
-        raise ValueError(f"torn slab transfer from {url}: rows={rows} "
-                         f"dim={dim} bytes={len(payload)}")
-    return np.frombuffer(payload, "<f4").reshape(rows, dim).copy(), off
+        codec = r.headers.get("X-Knn-Wire")
+        if codec is None:
+            # legacy host: single raw f32 body (pre-codec binary)
+            payload = r.read()
+            if (rows < 0 or off < 0 or dim < 1
+                    or len(payload) != 4 * rows * dim):
+                raise ValueError(
+                    f"torn slab transfer from {url}: rows={rows} "
+                    f"dim={dim} bytes={len(payload)}")
+            return (np.frombuffer(payload, "<f4").reshape(rows, dim)
+                    .copy(), off)
+        if rows < 0 or off < 0 or dim < 1:
+            raise ValueError(f"torn slab transfer from {url}: "
+                             f"rows={rows} dim={dim}")
+        want_crc = int(r.headers.get("X-Knn-Fingerprint", "0"), 16)
+        parts = []
+        crc = 0
+        wire_bytes = 0
+        try:
+            for nrows, payload in read_frames(r.read, rows):
+                pts = decode_slab_chunk(payload, nrows, dim)
+                parts.append(pts)
+                crc = zlib.crc32(memoryview(pts).cast("B"), crc)
+                wire_bytes += 8 + len(payload)
+                if throttle_bps:
+                    # pace AFTER decode against the cumulative byte
+                    # deadline: decode rides inside the bandwidth gap,
+                    # the way real transfer overlaps decode
+                    target = t0 + wire_bytes / float(throttle_bps)
+                    delay = target - time.perf_counter()
+                    if delay > 0:
+                        time.sleep(delay)
+        except WireError as e:
+            raise ValueError(f"torn slab transfer from {url}: {e}") from e
+        r.read()  # drain the terminal chunk so the close is graceful
+        if crc != want_crc:
+            raise ValueError(
+                f"slab fingerprint mismatch from {url}: decoded rows "
+                f"crc32 {crc:08x} != advertised {want_crc:08x}")
+    out = (np.concatenate(parts, axis=0) if parts
+           else np.zeros((0, dim), "<f4"))
+    return np.ascontiguousarray(out, "<f4"), off
 
 
 def _http_adopt(url: str, req: dict, timeout_s: float) -> dict:
@@ -429,6 +492,12 @@ class ReplicaManager:
                     "pod table was built from", rejected=True)
                 return
             self.fanout.bind_replica(slab, url)
+            # register the adoptee's wire caps (the /stats ROOT block)
+            # so the fan-out negotiates its codec like any startup host;
+            # an old binary has no caps and negotiates f32
+            negotiator = getattr(self.fanout, "negotiator", None)
+            if negotiator is not None:
+                negotiator.set_caps(url, stats.get("wire"))
             if self.fingerprint_registry is not None:
                 self.fingerprint_registry[url] = (want if want is not None
                                                   else fp)

@@ -139,6 +139,11 @@ def _streaming_prometheus_lines(engine_stats: dict) -> list[str]:
         "# TYPE knn_stream_skipped_promotions_total counter",
         f"knn_stream_skipped_promotions_total "
         f"{streaming['skipped_promotions']}",
+        # drift guard (PR 17): skip-cold plans refused because the pool
+        # was already stalling above the admission limit
+        "# TYPE knn_stream_skip_cold_refusals_total counter",
+        f"knn_stream_skip_cold_refusals_total "
+        f"{streaming.get('skip_cold_refusals', 0)}",
     ]
 
 
@@ -332,6 +337,28 @@ class JsonHttpHandler(BaseHTTPRequestHandler):
 
     def _send_json(self, code: int, obj, extra=()):
         self._send(code, json.dumps(obj).encode(), "application/json", extra)
+
+    # chunked-response writer: ``_send`` always sets Content-Length, which
+    # forces the whole body to be materialized up front — exactly the
+    # transient-RAM doubling /slab_rows must avoid. These three stream an
+    # HTTP/1.1 chunked body instead (http.client reassembles transparently
+    # on the pull side).
+    def _start_chunked(self, code: int, ctype: str, extra=()):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Transfer-Encoding", "chunked")
+        for k, v in extra:
+            self.send_header(k, v)
+        self.end_headers()
+
+    def _write_chunk(self, data: bytes):
+        if data:
+            self.wfile.write(b"%x\r\n" % len(data))
+            self.wfile.write(data)
+            self.wfile.write(b"\r\n")
+
+    def _end_chunked(self):
+        self.wfile.write(b"0\r\n\r\n")
 
     def _apply_fault(self, path: str) -> bool:
         """Consult the server's FaultInjector (if any) for this request;

@@ -87,22 +87,48 @@ class SlabSource:
     the reference's integer split ``[N*s/S, N*(s+1)/S)`` via
     ``read_file_portion`` for ``.float3``, an mmap slice for ``.npy``, a
     plain slice for an in-memory array (the routed streaming path hands
-    its already-loaded host slab here). Reads are stateless and
+    its already-loaded host slab here), or — with ``url=`` — a row
+    sub-range pulled over HTTP from a host serving the full index
+    (``GET /slab_rows?wire=d16&begin=&end=``: the PR-17 delta codec, so
+    cold-tier promotions move ~0.55x the f32 bytes and are
+    fingerprint-verified lossless after decode; an old host falls back
+    to the single-shot f32 body automatically). Reads are stateless and
     thread-compatible — the pool's locking lives above this."""
 
     def __init__(self, *, path: str | None = None, points=None,
-                 num_slabs: int):
+                 url: str | None = None, num_slabs: int,
+                 wire: str = "d16", timeout_s: float = 120.0,
+                 throttle_bps: float | None = None):
         from mpi_cuda_largescaleknn_tpu.models.sharding import slab_bounds
 
-        if (path is None) == (points is None):
-            raise ValueError("need exactly one of path= or points=")
+        if sum(x is not None for x in (path, points, url)) != 1:
+            raise ValueError("need exactly one of path=, points= or url=")
         if num_slabs < 1:
             raise ValueError(f"num_slabs must be >= 1, got {num_slabs}")
         self.path = path
         self.num_slabs = int(num_slabs)
         self._points = None
         self._mmap = None
-        if points is not None:
+        self._url = None
+        if url is not None:
+            import json as _json
+            import urllib.request as _rq
+
+            self._url = url.rstrip("/")
+            self._wire = wire
+            self._timeout_s = float(timeout_s)
+            self._throttle_bps = throttle_bps
+            with _rq.urlopen(self._url + "/stats",
+                             timeout=self._timeout_s) as r:
+                est = _json.loads(r.read()).get("engine") or {}
+            self.n_total = int(est.get("n_points", -1))
+            self.dim = int(est.get("dim", 0))
+            off = int(est.get("row_offset", -1))
+            if self.n_total < 0 or self.dim < 1 or off != 0:
+                raise ValueError(
+                    f"{url}: not a full-index source host (n_points="
+                    f"{self.n_total} dim={self.dim} row_offset={off})")
+        elif points is not None:
             self._points = np.asarray(points, np.float32)
             if self._points.ndim != 2 or self._points.shape[1] < 1:
                 raise ValueError(f"points must be [N, D], got "
@@ -126,6 +152,19 @@ class SlabSource:
     def read(self, slab: int) -> np.ndarray:
         """Materialize slab ``slab``'s rows (f32[n, dim])."""
         b, e = self.bounds[slab]
+        if self._url is not None:
+            from mpi_cuda_largescaleknn_tpu.serve.replica import (
+                pull_slab_rows,
+            )
+
+            rows, off = pull_slab_rows(
+                self._url, timeout_s=self._timeout_s, wire=self._wire,
+                begin=b, end=e, throttle_bps=self._throttle_bps)
+            if off != b or len(rows) != e - b:
+                raise ValueError(
+                    f"{self._url}: slab {slab} range drifted: got "
+                    f"[{off}, {off + len(rows)}) want [{b}, {e})")
+            return rows
         if self._points is not None:
             return np.asarray(self._points[b:e], np.float32)
         if self._mmap is not None:
@@ -240,6 +279,12 @@ class SlabPool:
     def _note_stall(self, seconds: float) -> None:  # lsk: holds[_cv]
         self.stream_stalls += 1
         self.stream_stall_seconds += max(0.0, float(seconds))
+
+    def stall_totals(self) -> tuple:
+        """(stalls, cumulative stall seconds) — the drift guard's cheap
+        sample, without building the full stats dict."""
+        with self._cv:
+            return self.stream_stalls, self.stream_stall_seconds
 
     def _host_put(self, slab: int, rows) -> None:  # lsk: holds[_cv]
         """Insert/refresh a slab's rows in the host tier; trim LRU past
@@ -538,7 +583,7 @@ class _StreamHandle:
     (serve/recall.py, None = exact) the batch runs under."""
 
     __slots__ = ("queries", "n", "engine_name", "t0", "lb", "visited",
-                 "subs", "pinned", "plan")
+                 "subs", "pinned", "plan", "skip_cold")
 
     def __init__(self, queries, n, engine_name, t0, plan=None):
         self.queries = queries
@@ -550,6 +595,10 @@ class _StreamHandle:
         self.subs = []
         self.pinned = set()
         self.plan = plan
+        #: dispatch's ADMITTED skip-cold decision for this batch (the
+        #: drift guard may refuse the plan's ask); the fold must follow
+        #: the same decision or wave 1 and escalation would disagree
+        self.skip_cold = False
 
 
 class StreamingKnnEngine:
@@ -576,6 +625,10 @@ class StreamingKnnEngine:
                  query_buckets: int = 0, score_dtype: str = "f32",
                  id_offset: int = 0, emit: str = "final",
                  faults: FaultInjector | None = None,
+                 source_url: str | None = None,
+                 source_wire: str = "d16",
+                 source_throttle_bps: float | None = None,
+                 skip_cold_stall_limit: float = 0.25,
                  clock=time.perf_counter):
         from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
         from mpi_cuda_largescaleknn_tpu.parallel.ring import resolve_engine
@@ -588,7 +641,9 @@ class StreamingKnnEngine:
             raise ValueError(f"emit must be 'final' or 'candidates', "
                              f"got {emit!r}")
         self._source = SlabSource(path=path, points=points,
-                                  num_slabs=num_slabs)
+                                  url=source_url, num_slabs=num_slabs,
+                                  wire=source_wire,
+                                  throttle_bps=source_throttle_bps)
         self.num_slabs = self._source.num_slabs
         self.n_points = self._source.n_total
         self.dim = self._source.dim
@@ -616,6 +671,16 @@ class StreamingKnnEngine:
         self._engine_name: guarded_by("_meta_lock") = resolve_engine(engine)
         self._degraded_reason: guarded_by("_meta_lock") = None
         self._launch_workers: guarded_by("_meta_lock") = 1
+        #: drift guard for the recall tier: recent (clock, cumulative
+        #: stall seconds) samples; when the pool is ALREADY stalling
+        #: above ``skip_cold_stall_limit`` (fraction of wall time spent
+        #: stalled over the sampled window), a ``stream_skip_cold`` plan
+        #: is REFUSED for the batch — under traffic drift the skip tier
+        #: collapses recall AND still pays promotion churn (TUNING.md),
+        #: so exact serving is strictly the better failure mode
+        self.skip_cold_stall_limit = float(skip_cold_stall_limit)
+        self._stall_ring: guarded_by("_meta_lock") = []
+        self.skip_cold_refusals: guarded_by("_meta_lock") = 0
         #: one shape class for every slab engine: pad each engine's local
         #: shards to the LARGEST slab's per-shard row count, so the shared
         #: ExecutableCache hits across slabs and re-promotions
@@ -786,6 +851,34 @@ class StreamingKnnEngine:
 
     # --------------------------------------------------------------- query API
 
+    #: samples kept by the drift guard: enough history to smooth one
+    #: noisy batch, short enough that recovery re-admits within ~a ring
+    skip_cold_window = 64
+
+    def _skip_cold_admit(self) -> bool:
+        """Drift-aware admission for ``stream_skip_cold`` (TUNING.md's
+        PR-16 caveat, closed): sample the pool's cumulative stall clock,
+        and refuse the recall plan when the stall FRACTION over the
+        sampled window is already above ``skip_cold_stall_limit`` — a
+        pool that busy promoting is in traffic drift, where skipping
+        collapses recall without saving the churn. Counted in
+        ``skip_cold_refusals``; rides the injectable clock."""
+        now = self._clock()
+        _stalls, stall_s = self._pool.stall_totals()
+        with self._meta_lock:
+            ring = self._stall_ring
+            ring.append((now, stall_s))
+            if len(ring) > self.skip_cold_window:
+                del ring[0]
+            t0, s0 = ring[0]
+            span = now - t0
+            if len(ring) < 2 or span <= 0.0:
+                return True  # no signal yet: admit
+            if (stall_s - s0) / span > self.skip_cold_stall_limit:
+                self.skip_cold_refusals += 1
+                return False
+            return True
+
     def dispatch(self, queries: np.ndarray, plan=None) -> _StreamHandle:
         """Wave 1 of the streamed batch: route rows to their
         nearest-bounds slab plus every slab whose box contains them (the
@@ -813,7 +906,9 @@ class StreamingKnnEngine:
             return handle
         lb, want = self._wave1_want(queries)
         visited = np.zeros((n, self.num_slabs), bool)
-        if plan is not None and plan.stream_skip_cold:
+        handle.skip_cold = (plan is not None and plan.stream_skip_cold
+                            and self._skip_cold_admit())
+        if handle.skip_cold:
             resident = set(self._pool.resident_slabs())
             first = np.argmin(lb, axis=1)
             must = set(int(s) for i, s in enumerate(first)
@@ -875,7 +970,7 @@ class StreamingKnnEngine:
         # recall plan: (c) shave the escalation margin, (d) never stall
         # an escalation wave on a cold slab — skip it for recall instead
         slack = float(plan.route_slack) if plan is not None else 0.0
-        skip_cold = plan is not None and plan.stream_skip_cold
+        skip_cold = handle.skip_cold
         lb_safe = lb * (1.0 - self.cert_slack)
         reachable = np.isfinite(lb_safe)
         subs = handle.subs
@@ -966,6 +1061,14 @@ class StreamingKnnEngine:
     def query(self, queries: np.ndarray, plan=None):
         return self.complete(self.dispatch(queries, plan=plan))
 
+    def refetch_exact(self, queries):
+        """Survivor re-fetch hook (PR-17 quantized wire): exact f32
+        candidate rows, byte-equal to any earlier batch containing these
+        rows — the streaming fold is bit-deterministic per query row
+        (commutative fold + certification closure), so re-asking costs a
+        promotion at worst, never bits."""
+        return self.complete_candidates(self.dispatch(queries))
+
     def close(self) -> None:
         self._pool.close()
 
@@ -977,6 +1080,7 @@ class StreamingKnnEngine:
         with self._meta_lock:
             engine_name = self._engine_name
             degraded_reason = self._degraded_reason
+            skip_cold_refusals = self.skip_cold_refusals
         return {
             "engine": engine_name,
             "merge": self.merge_mode,
@@ -1036,6 +1140,10 @@ class StreamingKnnEngine:
                 # skipped for recall instead of stalled on
                 "skipped_promotions":
                     self.timers.counter("stream_skipped_promotions"),
+                # drift guard (PR 17): skip-cold plans refused because
+                # the pool's stall fraction was already above the limit
+                "skip_cold_refusals": skip_cold_refusals,
+                "skip_cold_stall_limit": self.skip_cold_stall_limit,
             },
             "timers": self.timers.report(),
         }

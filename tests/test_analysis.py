@@ -535,6 +535,7 @@ class TestRepoGate:
             "serve/replica.py": {"ReplicaSet", "ReplicaManager"},
             "serve/server.py": {"ServingMetrics"},
             "serve/slabpool.py": {"SlabPool", "StreamingKnnEngine"},
+            "serve/wire.py": {"WireNegotiator", "WireStats"},
         }
         for rel, expected in want.items():
             path = os.path.join(base, "mpi_cuda_largescaleknn_tpu", rel)

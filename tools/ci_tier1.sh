@@ -61,9 +61,16 @@ if [ "${SERVE_BENCH:-1}" != "0" ] && [ "$rc" -ne 124 ]; then
   # >= 3x exact on clustered (engine tier), the no-recall default path
   # staying bitwise identical through the live server, and the
   # exact:false / X-Knn-* / stats / metrics response contract
-  timeout -k 10 2700 python tools/serve_smoke.py --duration 2 --trials 3 \
+  # --wire-bench adds the quantized-wire section (wire_compare): the
+  # q16 candidate exchange + x32 survivor re-fetch vs the f32 wire on
+  # routed/replicated/streaming/mixed-codec pods — gated on bitwise
+  # probe parity per pod, exchange bytes-per-row <= 0.45x f32, and the
+  # d16 slab handoff being lossless with a paced-transfer seconds
+  # ratio <= 0.6x f32
+  timeout -k 10 3300 python tools/serve_smoke.py --duration 2 --trials 3 \
       --locality-bench --multihost-bench --kernel-bench --routing-bench \
       --chaos-bench --replica-bench --streaming-bench --recall-bench \
+      --wire-bench \
       --out BENCH_serve.json >/dev/null || { brc=$?; [ "$rc" -eq 0 ] && rc=$brc; }
 fi
 # the lskcheck gate blocks even when the tests pass (and never masks a

@@ -192,7 +192,33 @@ def _server_pipeline_stats(url: str, timeout_s: float) -> dict | None:
         # escalation rate and mean hosts visited per query, so one loadgen
         # run shows clustered-vs-uniform routing behavior end to end
         **_routing_projection(stats),
+        # wire-codec surface (PR 17): which codec each endpoint
+        # negotiated and the observed exchange bytes-per-row — the
+        # compression ratio as measured by the server, not the bench
+        **_wire_projection(stats),
     }
+
+
+def _wire_projection(stats: dict) -> dict:
+    """Codec-in-use + bytes-per-row per (path, codec). Reads a pod front
+    end's fan-out table (``fanout.wire``: mode, per-url negotiation,
+    traffic) or a single host's root ``wire_traffic`` block; an old
+    server has neither and projects nothing."""
+    out: dict = {}
+    fan = stats.get("fanout", {}).get("wire")
+    if fan:
+        out["wire_mode"] = fan.get("mode")
+        out["wire_negotiated"] = fan.get("negotiated")
+        traffic = fan.get("traffic")
+    else:
+        traffic = stats.get("wire_traffic")
+    if traffic:
+        out["wire_bytes_per_row"] = {
+            f"{path}:{codec}": cell.get("bytes_per_row")
+            for path, codecs in traffic.items()
+            for codec, cell in codecs.items()
+            if "bytes_per_row" in cell}
+    return out
 
 
 def _routing_projection(stats: dict) -> dict:
