@@ -37,7 +37,8 @@ block (absent caps = an old binary = f32 — mixed pods interop):
     mapped to the total-order u32 space (sign-flip transform: float
     compare == unsigned compare), delta-coded row-to-row, zigzag'd, and
     stored as byte planes: 16-bit deltas when the chunk's steps fit
-    (tight Morton runs), 32-bit otherwise, raw f32 when the transform
+    (tight Morton runs), 32-bit next, 64-bit when steps cross zero at
+    magnitude > ~1 (zigzag can reach 2^33), raw f32 when the transform
     does not pay — then zlib. The transform is pure integer arithmetic
     in ulp space: **lossless always**, verified by a crc32 fingerprint
     of the raw f32 bytes after decode (torn / corrupt transfers raise
@@ -340,8 +341,14 @@ def decode_candidates_q16(
     ids = np.full((m, k), -1, np.int64)
     ids[mask] = flat_ids
     # bounds: the exact f64 expression the encoder certified against,
-    # rounded outward into f32; level 65535 is the anchor verbatim and
-    # level 0 is an exact zero, so those slots carry lo == hi
+    # rounded outward into f32. Only the row's ACTUAL anchor slot (and
+    # level 0 = an exact zero) carries lo == hi: an interior distance
+    # within 1/65535 of the anchor also ceils to level 65535, and
+    # handing it lo == anchor would overstate its lower bound above the
+    # true d2 — the frontend's strict lo > kth test would then serve a
+    # row verbatim that an exact fold could still change.
+    anchor_slot = np.zeros((m, k), bool)
+    anchor_slot[rows, n_valid[rows] - 1] = True
     a64 = anchors.astype(np.float64)[:, None]
     hi64 = a64 * u / 65535.0
     hi32 = hi64.astype(np.float32)
@@ -357,7 +364,7 @@ def decode_candidates_q16(
     lo32 = np.where(drop, np.nextafter(lo32, np.float32(-np.inf)), lo32)
     lo32 = np.maximum(np.nextafter(lo32, np.float32(-np.inf)),
                       np.float32(0.0))
-    exact = (u == 65535) | (u == 0)
+    exact = anchor_slot | (u == 0)
     lo32 = np.where(exact, hi32, lo32)
     d2_hi = np.where(mask, hi32, np.float32(pad_value)).astype("<f4")
     d2_lo = np.where(mask, lo32, np.float32(pad_value)).astype("<f4")
@@ -370,10 +377,11 @@ def decode_candidates_q16(
 
 def encode_slab_chunk(pts: np.ndarray, level: int = 6) -> bytes:
     """Encode one chunk of Morton-sorted f32 rows, losslessly. Ladder:
-    16-bit zigzag ulp deltas when every step fits, 32-bit otherwise, raw
-    f32 when the transform + zlib does not actually shrink the chunk.
-    Default zlib level 6 (not the wire default 1): slab pulls are
-    bandwidth-bound, not encode-bound, so the extra effort pays."""
+    16-bit zigzag ulp deltas when every step fits, then 32-bit, then
+    64-bit (sign-crossing steps zigzag up to 2^33), raw f32 when the
+    transform + zlib does not actually shrink the chunk. Default zlib
+    level 6 (not the wire default 1): slab pulls are bandwidth-bound,
+    not encode-bound, so the extra effort pays."""
     pts = np.ascontiguousarray(pts, "<f4")
     m, dim = pts.shape
     if m == 0:
@@ -382,12 +390,18 @@ def encode_slab_chunk(pts: np.ndarray, level: int = 6) -> bytes:
     u = float_to_ordered_u32(pts).astype(np.int64)
     deltas = np.diff(u, axis=0)
     zz = _zigzag(deltas) if m > 1 else np.zeros((0, dim), np.uint64)
-    width = 2 if (zz.size == 0 or zz.max() < 65536) else 4
+    # zigzag'd steps between ordered-u32 values span [0, 2^33): rows
+    # that cross zero with |coord| > ~1 overflow a u32, so the ladder
+    # tops out at 8-byte planes (the high planes are near-constant
+    # zeros and vanish under zlib; the raw-f32 escape below still
+    # catches chunks where the transform does not pay)
+    zmax = 0 if zz.size == 0 else int(zz.max())
+    width = 2 if zmax < 2 ** 16 else 4 if zmax < 2 ** 32 else 8
     # only the first row rides raw; zigzag ulp deltas carry the rest
     body = (_D16_MAGIC + struct.pack("<BBIH", 1, width, m, dim)
             + u[0].astype("<u4").tobytes()
-            + _planes(zz.astype({2: np.uint16, 4: np.uint32}[width]),
-                      width))
+            + _planes(zz.astype({2: np.uint16, 4: np.uint32,
+                                 8: np.uint64}[width]), width))
     enc = zlib.compress(body, level)
     if len(enc) + 1 >= len(raw):
         return b"\x00" + bytes(raw)
@@ -414,7 +428,7 @@ def decode_slab_chunk(payload: bytes, m: int, dim: int) -> np.ndarray:
     if len(body) < head or body[:2] != _D16_MAGIC:
         raise WireError("d16 chunk missing magic")
     ver, width, mm, dd = struct.unpack("<BBIH", body[2:head])
-    if ver != 1 or mm != m or dd != dim or width not in (2, 4):
+    if ver != 1 or mm != m or dd != dim or width not in (2, 4, 8):
         raise WireError(f"d16 header mismatch: ver={ver} width={width} "
                         f"rows={mm} (want {m}) dim={dd} (want {dim})")
     first_end = head + 4 * dim

@@ -173,6 +173,19 @@ class TestQ16Codec:
         hi, lo = self._roundtrip(d2, idx)
         assert (hi[idx < 0] == np.float32(2.25)).all()
 
+    def test_interior_slot_in_anchor_band_keeps_sound_lo(self):
+        # an interior d2 within 1/65535 of the anchor also ceils to
+        # level 65535; it must NOT decode to lo == anchor (the frontend
+        # serves a row verbatim when every other contribution's lo
+        # strictly exceeds its kth — an overstated lo drops a true
+        # neighbor from a row served with exact=True)
+        d2 = np.array([[9.99999, 10.0]], "<f4")
+        idx = np.array([[1, 2]], "<i4")
+        hi, lo = self._roundtrip(d2, idx)
+        assert lo[0, 0] <= d2[0, 0]
+        assert lo[0, 0] < np.float32(10.0)
+        assert hi[0, 0] == np.float32(10.0)  # hi = anchor stays valid
+
     def test_zero_distance_slots_are_exact(self):
         d2, idx = _rows(4, K, seed=6)
         d2[:, 0] = 0.0  # exact-match neighbor
@@ -255,6 +268,21 @@ class TestD16Codec:
         pts = _morton_points(128, seed=20) - np.float32(0.5)
         out = decode_slab_chunk(encode_slab_chunk(pts), 128, 3)
         assert np.array_equal(out.view(np.uint32), pts.view(np.uint32))
+
+    def test_sign_crossing_magnitude_gt1_roundtrip(self):
+        # consecutive rows crossing zero at |coord| > ~1 produce
+        # zigzag'd ordered-u32 steps up to ~2^33: the width ladder must
+        # widen to 8-byte planes instead of silently truncating to u32
+        rng = np.random.default_rng(24)
+        pts = (rng.random((4096, 3)).astype("<f4")
+               * np.float32(6.0) - np.float32(3.0)).astype("<f4")
+        out = decode_slab_chunk(encode_slab_chunk(pts), 4096, 3)
+        assert np.array_equal(out.view(np.uint32), pts.view(np.uint32))
+        # the adversarial pair alone: one maximal sign-crossing step
+        pair = np.array([[-3.0, -3e38, 1e-38],
+                         [3.0, 3e38, -1e-38]], "<f4")
+        out = decode_slab_chunk(encode_slab_chunk(pair), 2, 3)
+        assert np.array_equal(out.view(np.uint32), pair.view(np.uint32))
 
     def test_morton_sorted_rows_compress(self):
         pts = _morton_points(4096, seed=21, scale=0.01)
