@@ -10,7 +10,10 @@ serves queries over HTTP until killed:
         [--timeout-ms 5000]
 
 Endpoints: POST /knn (JSON or binary), GET /healthz, /stats, /metrics
-(Prometheus text). See docs/SERVING.md and tools/loadgen.py.
+(Prometheus text). With --tenant (repeatable) the process serves MANY
+indexes from one slab pool: POST /v1/<tenant>/knn, per-tenant /stats
+namespaces, {tenant=} metric labels, and per-tenant admission quotas
+(docs/SERVING.md "Multi-index tenancy"). See tools/loadgen.py.
 """
 
 from __future__ import annotations
@@ -59,9 +62,33 @@ SERVE_FLAGS = """
                     slab working set (suffixes k/m/g; 0 = unbounded),
                     counted against each slab engine's reported
                     device_bytes footprint; LRU-with-pin eviction
-  --host-pool-slabs N  host-RAM row-pool capacity in slabs (0 =
-                    unbounded); slabs past it re-read from the mmap/file
-                    cold tier
+  --host-pool-bytes B  host-RAM row-pool budget in bytes (suffixes
+                    k/m/g; 0 = unbounded); slabs past it re-read from
+                    the mmap/file cold tier. Byte accounting is what
+                    keeps mixed-size tenant slabs from blowing the host
+                    tier — prefer it over --host-pool-slabs
+  --host-pool-slabs N  DEPRECATED fallback: the same cap counted in
+                    slabs (0 = unbounded). Kept for existing deploy
+                    scripts; slab counts only bound memory when every
+                    slab is the same size — use --host-pool-bytes.
+                    Both caps apply when both are set
+  --tenant NAME=PATH  multi-index tenancy (repeatable; serve/tenancy.py):
+                    serve PATH's index as tenant NAME at
+                    POST /v1/NAME/knn. All tenants share ONE slab pool
+                    (--device-slab-budget, --host-pool-bytes), one AOT
+                    executable cache (compile count stays flat as
+                    tenants grow), and one admission controller. The
+                    FIRST --tenant is the default tenant — legacy /knn
+                    routes to it. Each tenant's index is split into
+                    --num-slabs slabs (default 1). Incompatible with
+                    pod/routed/standby modes; a positional input file is
+                    not used (and rejected) in tenancy mode
+  --tenant-quota-rows N  per-tenant admission quota: each tenant may
+                    hold at most N queued+in-flight rows of the global
+                    --max-queue-rows budget (0 = unsliced, global cap
+                    only). Over-quota requests get 429 + Retry-After
+                    like global overload, so one hot tenant cannot
+                    starve the rest
   --prefetch-depth N  next-nearest slabs promoted asynchronously per
                     dispatched batch (default 1; the batcher additionally
                     announces the next batch's routed slab set a batch
@@ -148,7 +175,9 @@ def parse_serve_args(argv: list[str]) -> dict:
            "bucket_size": 0, "query_buckets": 0,
            "max_batch": 1024, "min_batch": 8,
            "num_slabs": 0, "device_slab_budget": 0,
-           "host_pool_slabs": 0, "prefetch_depth": 1,
+           "host_pool_slabs": 0, "host_pool_bytes": 0,
+           "tenants": [], "tenant_quota_rows": 0,
+           "prefetch_depth": 1,
            "max_delay_ms": 2.0, "pipeline_depth": 2,
            "max_queue_rows": 4096, "seq_timeout_s": None,
            "recall_policy": None,
@@ -192,6 +221,16 @@ def parse_serve_args(argv: list[str]) -> dict:
                 i += 1; opt["device_slab_budget"] = parse_bytes(argv[i])
             elif arg == "--host-pool-slabs":
                 i += 1; opt["host_pool_slabs"] = int(argv[i])
+            elif arg == "--host-pool-bytes":
+                i += 1; opt["host_pool_bytes"] = parse_bytes(argv[i])
+            elif arg == "--tenant":
+                i += 1
+                name, sep, path = argv[i].partition("=")
+                if not sep or not name or not path:
+                    usage(f"--tenant wants NAME=PATH, got '{argv[i]}'")
+                opt["tenants"].append((name, path))
+            elif arg == "--tenant-quota-rows":
+                i += 1; opt["tenant_quota_rows"] = int(argv[i])
             elif arg == "--prefetch-depth":
                 i += 1; opt["prefetch_depth"] = int(argv[i])
             elif arg == "--max-delay-ms":
@@ -229,7 +268,18 @@ def parse_serve_args(argv: list[str]) -> dict:
             i += 1
     except (IndexError, ValueError):
         usage(f"invalid or missing value for '{argv[i - 1] if i else ''}'")
-    if not opt["in_path"]:
+    if opt["tenants"]:
+        if opt["in_path"]:
+            usage("tenancy mode takes its inputs from --tenant NAME=PATH "
+                  f"— drop the positional input '{opt['in_path']}'")
+        if opt["num_hosts"] > 1 or opt["routing"] != "off" or opt["standby"]:
+            usage("--tenant (multi-index tenancy) is single-process "
+                  "serving — it does not combine with pod, routed, or "
+                  "standby modes")
+        names = [n for n, _p in opt["tenants"]]
+        if len(set(names)) != len(names):
+            usage(f"duplicate tenant names in {names}")
+    elif not opt["in_path"]:
         usage("no input file name specified")
     if opt["k"] < 1:
         usage("no k specified, or invalid k value")
@@ -313,6 +363,56 @@ def main(argv: list[str] | None = None) -> int:
             server.close()
         return 0
 
+    if opt["tenants"]:
+        # multi-index tenancy: many indexes behind ONE slab pool, one AOT
+        # cache, one admission controller (serve/tenancy.py). Each
+        # tenant's index streams as --num-slabs slabs (default 1)
+        from mpi_cuda_largescaleknn_tpu.serve.tenancy import (
+            MultiTenantEngine,
+            TenantSpec,
+        )
+
+        engine = MultiTenantEngine(
+            [TenantSpec(name, path=path,
+                        num_slabs=max(1, opt["num_slabs"]))
+             for name, path in opt["tenants"]],
+            k=opt["k"], mesh=get_mesh(opt["shards"]),
+            device_slab_budget=opt["device_slab_budget"],
+            host_pool_slabs=opt["host_pool_slabs"],
+            host_pool_bytes=opt["host_pool_bytes"],
+            prefetch_depth=opt["prefetch_depth"], engine=opt["engine"],
+            bucket_size=opt["bucket_size"], max_radius=opt["max_radius"],
+            max_batch=opt["max_batch"], min_batch=opt["min_batch"],
+            merge=opt["merge"], query_buckets=opt["query_buckets"],
+            score_dtype=opt["score_dtype"])
+        print(f"multi-index tenancy: {len(opt['tenants'])} tenants "
+              f"({', '.join(n for n, _p in opt['tenants'])}), "
+              f"{engine.n_points} points total, default tenant "
+              f"'{engine.default_tenant}', quota "
+              f"{opt['tenant_quota_rows'] or 'unsliced'} rows/tenant")
+        recall_policy = None
+        if opt["recall_policy"]:
+            from mpi_cuda_largescaleknn_tpu.serve.recall import RecallPolicy
+
+            recall_policy = RecallPolicy.from_file(opt["recall_policy"])
+        server = build_server(
+            engine, host=opt["host"], port=opt["port"],
+            max_delay_s=opt["max_delay_ms"] / 1e3,
+            pipeline_depth=opt["pipeline_depth"],
+            max_queue_rows=opt["max_queue_rows"],
+            default_timeout_s=opt["timeout_ms"] / 1e3,
+            verbose=opt["verbose"], recall_policy=recall_policy,
+            tenant_quota_rows=opt["tenant_quota_rows"])
+        try:
+            serve_forever(server, warmup=opt["warmup"])
+        except KeyboardInterrupt:
+            print("shutting down")
+        finally:
+            server.close()
+            if opt["timings"]:
+                sys.stderr.write(engine.timers.dump() + "\n")
+        return 0
+
     streaming = opt["num_slabs"] > 0
     id_offset = 0
     if routed and streaming:
@@ -334,6 +434,7 @@ def main(argv: list[str] | None = None) -> int:
             points=rows, num_slabs=opt["num_slabs"], k=opt["k"],
             device_slab_budget=opt["device_slab_budget"],
             host_pool_slabs=opt["host_pool_slabs"],
+            host_pool_bytes=opt["host_pool_bytes"],
             prefetch_depth=opt["prefetch_depth"],
             mesh=get_mesh(opt["shards"]), engine=opt["engine"],
             bucket_size=opt["bucket_size"], max_radius=opt["max_radius"],
@@ -384,6 +485,7 @@ def main(argv: list[str] | None = None) -> int:
             opt["in_path"], num_slabs=opt["num_slabs"], k=opt["k"],
             device_slab_budget=opt["device_slab_budget"],
             host_pool_slabs=opt["host_pool_slabs"],
+            host_pool_bytes=opt["host_pool_bytes"],
             prefetch_depth=opt["prefetch_depth"],
             mesh=get_mesh(opt["shards"]), engine=opt["engine"],
             bucket_size=opt["bucket_size"], max_radius=opt["max_radius"],
@@ -395,7 +497,9 @@ def main(argv: list[str] | None = None) -> int:
               f"{opt['num_slabs']} slabs ({engine.slab_device_bytes} B "
               f"per resident slab; device budget "
               f"{opt['device_slab_budget'] or 'unbounded'} B, host pool "
-              f"{opt['host_pool_slabs'] or 'unbounded'} slabs)")
+              + (f"{opt['host_pool_bytes']} B" if opt["host_pool_bytes"]
+                 else f"{opt['host_pool_slabs'] or 'unbounded'} slabs")
+              + ")")
     else:
         points = read_points(opt["in_path"])
         n_total = len(points)

@@ -156,28 +156,35 @@ class GracefulQueryFn:
                     == self.engine.engine_name):
                 raise e
 
-    def _query(self, queries, plan):
-        # exact requests use the legacy single-arg form so engines (and
-        # test doubles) without a plan kwarg keep working — the batcher's
-        # compatibility rule, applied to the degradation shim too
+    def _query(self, queries, plan, tenant=None):
+        # exact single-index requests use the legacy single-arg form so
+        # engines (and test doubles) without a plan/tenant kwarg keep
+        # working — the batcher's compatibility rule, applied to the
+        # degradation shim too
+        if tenant is not None:
+            return self.engine.query(queries, plan=plan, tenant=tenant)
         return (self.engine.query(queries) if plan is None
                 else self.engine.query(queries, plan=plan))
 
-    def __call__(self, queries, plan=None):
+    def __call__(self, queries, plan=None, tenant=None):
         try:
-            return self._query(queries, plan)
+            return self._query(queries, plan, tenant)
         except Exception as e:  # noqa: BLE001 - re-raised unless degradable
             self._degrade_or_raise(e)
-            return self._query(queries, plan)
+            return self._query(queries, plan, tenant)
 
-    def dispatch(self, queries, plan=None):
+    def _dispatch(self, queries, plan, tenant=None):
+        if tenant is not None:
+            return self.engine.dispatch(queries, plan=plan, tenant=tenant)
+        return (self.engine.dispatch(queries) if plan is None
+                else self.engine.dispatch(queries, plan=plan))
+
+    def dispatch(self, queries, plan=None, tenant=None):
         try:
-            return (self.engine.dispatch(queries) if plan is None
-                    else self.engine.dispatch(queries, plan=plan))
+            return self._dispatch(queries, plan, tenant)
         except Exception as e:  # noqa: BLE001 - re-raised unless degradable
             self._degrade_or_raise(e)
-            return (self.engine.dispatch(queries) if plan is None
-                    else self.engine.dispatch(queries, plan=plan))
+            return self._dispatch(queries, plan, tenant)
 
     def complete(self, handle):
         try:
@@ -186,6 +193,8 @@ class GracefulQueryFn:
             self._degrade_or_raise(e, handle)
             # replay the retained host queries synchronously on the current
             # (degraded) engine — exact by the twin-engine contract, under
-            # the SAME recall plan the handle was dispatched with
+            # the SAME recall plan (and tenant namespace) the handle was
+            # dispatched with
             return self._query(handle.queries,
-                               getattr(handle, "plan", None))
+                               getattr(handle, "plan", None),
+                               getattr(handle, "tenant", None))

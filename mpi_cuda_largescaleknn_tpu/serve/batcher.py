@@ -68,6 +68,9 @@ class _Request:
     #: Requests only coalesce with plan-compatible neighbors — see
     #: ``_plan_key`` / ``_take_batch``.
     plan: object | None = None
+    #: tenant namespace (serve/tenancy.py; None = single-index serving).
+    #: Joins the coalescing key — one engine batch never mixes indexes.
+    tenant: str | None = None
     done: threading.Event = field(default_factory=threading.Event)
     result: tuple | None = None
     error: Exception | None = None
@@ -78,11 +81,15 @@ class _Request:
 
 
 def _plan_key(req: _Request):
-    """The coalescing key: requests whose plans execute identical bits may
-    share an engine batch. None (exact) is its own key; approximate plans
-    key on ``batch_key()``, which deliberately EXCLUDES ``recall_target``
-    — two requests on the same plan at different targets coalesce."""
-    return None if req.plan is None else req.plan.batch_key()
+    """The coalescing key: requests whose plans execute identical bits ON
+    THE SAME INDEX may share an engine batch. The plan part is None (exact,
+    its own key) or the plan's ``batch_key()``, which deliberately EXCLUDES
+    ``recall_target`` — two requests on the same plan at different targets
+    coalesce. The tenant part keeps multi-index traffic in per-tenant
+    sub-batches (None for single-index serving, so legacy keys are
+    unchanged tuples-of-None)."""
+    return (req.tenant,
+            None if req.plan is None else req.plan.batch_key())
 
 
 class DynamicBatcher:
@@ -185,20 +192,22 @@ class DynamicBatcher:
     # ------------------------------------------------------------------ submit
 
     def submit(self, queries: np.ndarray, timeout_s: float | None = None,
-               plan=None):
+               plan=None, tenant: str | None = None):
         """Block until the batch containing ``queries`` executes; returns
         ``(dists, neighbors)`` or raises the request's error. ``plan``
         (serve/recall.py RecallPlan, None = exact) rides the request and
         restricts coalescing to plan-compatible neighbors — mixed-SLO
         traffic splits into per-plan sub-batches instead of forcing the
-        strictest plan on everyone."""
+        strictest plan on everyone. ``tenant`` (serve/tenancy.py, None =
+        single-index) does the same per index: a flush never mixes two
+        tenants' rows in one engine batch."""
         # normalize to [n, dim] rows (flat inputs carry n*dim floats — the
         # legacy direct-caller contract, now D-generic via self.dim)
         queries = np.asarray(queries, np.float32).reshape(-1, self.dim)
         now = time.monotonic()
         req = _Request(queries=queries, enqueued=now,
                        deadline=(now + timeout_s) if timeout_s else None,
-                       plan=plan)
+                       plan=plan, tenant=tenant)
         with self._cond:
             if self._shutdown:
                 raise RuntimeError("batcher is shut down")
@@ -268,7 +277,7 @@ class DynamicBatcher:
                 rows += r.rows
             self._queued_rows -= rows
             self.batches += 1
-            if pkey is not None:
+            if batch[0].plan is not None:
                 self.batches_approx += 1
             if rows >= self.max_batch:
                 self.flush_full += 1
@@ -324,11 +333,16 @@ class DynamicBatcher:
                 t0 = time.perf_counter()
                 merged = (live[0].queries if len(live) == 1 else
                           np.concatenate([r.queries for r in live]))
-                # exact requests call the legacy single-arg form so plain
-                # test doubles (and the pre-tier wire) stay compatible
-                plan = live[0].plan
-                outs = (self._query_fn(merged) if plan is None
-                        else self._query_fn(merged, plan=plan))
+                # exact single-index requests call the legacy single-arg
+                # form so plain test doubles (and the pre-tier wire) stay
+                # compatible; tenant/plan kwargs only appear when set
+                plan, tenant = live[0].plan, live[0].tenant
+                if tenant is not None:
+                    outs = self._query_fn(merged, plan=plan, tenant=tenant)
+                elif plan is None:
+                    outs = self._query_fn(merged)
+                else:
+                    outs = self._query_fn(merged, plan=plan)
                 if self._timers is not None:
                     self._timers.hist("batch_exec_seconds").record(
                         time.perf_counter() - t0)
@@ -399,9 +413,14 @@ class DynamicBatcher:
                 self._timers.gauge("pipeline_inflight_batches", inflight)
             try:
                 t0 = time.perf_counter()
-                plan = live[0].plan
-                handle = (self._query_fn.dispatch(merged) if plan is None
-                          else self._query_fn.dispatch(merged, plan=plan))
+                plan, tenant = live[0].plan, live[0].tenant
+                if tenant is not None:
+                    handle = self._query_fn.dispatch(merged, plan=plan,
+                                                     tenant=tenant)
+                elif plan is None:
+                    handle = self._query_fn.dispatch(merged)
+                else:
+                    handle = self._query_fn.dispatch(merged, plan=plan)
             except Exception as e:  # noqa: BLE001 - delivered per request
                 self._fail(live, e)
                 with self._cond:
@@ -427,20 +446,25 @@ class DynamicBatcher:
         with self._cond:
             if not self._queue:
                 return
-            pending, rows = [], 0
+            # group by tenant: each index's prefetcher should only see its
+            # own rows (single-index queues collapse to one None group)
+            groups, rows = {}, 0
             for r in self._queue:
                 if rows + r.rows > self.max_batch:
                     break
-                pending.append(r.queries)
+                groups.setdefault(r.tenant, []).append(r.queries)
                 rows += r.rows
-        if not pending:
-            return
-        try:
-            self._prefetch_fn(pending[0] if len(pending) == 1
-                              else np.concatenate(pending))
-        except Exception:  # noqa: BLE001 - advisory; counted below
-            with self._cond:
-                self.prefetch_hint_errors += 1
+        for tenant, pending in groups.items():
+            try:
+                merged = (pending[0] if len(pending) == 1
+                          else np.concatenate(pending))
+                if tenant is None:
+                    self._prefetch_fn(merged)
+                else:
+                    self._prefetch_fn(merged, tenant=tenant)
+            except Exception:  # noqa: BLE001 - advisory; counted below
+                with self._cond:
+                    self.prefetch_hint_errors += 1
 
     def _run_complete(self):
         """Completion loop: block on the oldest in-flight batch, demux.

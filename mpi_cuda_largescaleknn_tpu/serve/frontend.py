@@ -1918,9 +1918,12 @@ class _FrontendHandler(JsonHttpHandler):
         srv.metrics.inc("knn_requests_total")
         t0 = time.perf_counter()
         try:
-            q, want_nbrs, timeout_s, recall, binary = parse_knn_body(
-                self.path, self.headers, self.rfile,
-                dim=getattr(srv.fanout, "dim", 3))
+            # the pod front end serves one index — the parsed tenant (a
+            # serve/tenancy.py concern) is ignored, like the single-index
+            # server does
+            q, want_nbrs, timeout_s, recall, _tenant, binary = (
+                parse_knn_body(self.path, self.headers, self.rfile,
+                               dim=getattr(srv.fanout, "dim", 3)))
         except (ValueError, json.JSONDecodeError) as e:
             srv.metrics.inc("knn_badrequest_total")
             self._send_json(400, {"error": str(e)})

@@ -226,22 +226,43 @@ class SlabPool:
     are never evicted; if a single batch's pinned set exceeds the budget
     the pool overcommits transiently (counted) rather than deadlock —
     the budget is a steady-state bound, not a per-batch straitjacket.
+
+    Multi-index tenancy (PR 18): pool keys are either plain slab ints
+    (the single-index legacy form — one source, one factory) or
+    ``(tenant, slab)`` tuples routed through a per-tenant registry
+    (``register``). All tenants share ONE device byte budget and ONE
+    host tier, so hot tenants naturally occupy the device tier while
+    cold tenants fall back to host-RAM/mmap and ride the same promotion
+    + cold-read path; per-tenant hit/stall/eviction accounting rides
+    alongside the pool-wide counters. A pool never mixes both key kinds.
     """
 
-    def __init__(self, source: SlabSource, engine_factory, *,
+    def __init__(self, source: SlabSource | None = None,
+                 engine_factory=None, *,
                  device_budget_bytes: int = 0, host_pool_slabs: int = 0,
+                 host_pool_bytes: int = 0,
                  faults: FaultInjector | None = None,
                  clock=time.perf_counter):
-        self._source = source
-        self._factory = engine_factory
         self._clock = clock
         self._sleep = time.sleep  # injectable: fault tests never sleep
         self._faults = faults
         self._cv = threading.Condition()
         # --- every field below is shared between caller threads (pin/
         # ensure/stats) and the promotion thread; all access under _cv ---
+        #: tenant -> (SlabSource, engine_factory). The legacy single-index
+        #: form registers under tenant ``None`` and keys the pool by bare
+        #: slab ints; multi-tenant callers register named tenants and key
+        #: by (tenant, slab)
+        self._routes: guarded_by("_cv") = {}
+        if source is not None:
+            self._routes[None] = (source, engine_factory)
+        #: per-tenant accounting (tuple-keyed pools only): tenant ->
+        #: counter dict, updated alongside the pool-wide totals
+        self._tenants: guarded_by("_cv") = {}
         self._budget: guarded_by("_cv") = int(device_budget_bytes)
         self._host_cap: guarded_by("_cv") = int(host_pool_slabs)
+        self._host_bytes_cap: guarded_by("_cv") = int(host_pool_bytes)
+        self._host_bytes: guarded_by("_cv") = 0
         self._device: guarded_by("_cv") = {}
         self._device_bytes: guarded_by("_cv") = 0
         #: host-RAM row pool, insertion-ordered oldest-first (dicts keep
@@ -270,34 +291,92 @@ class SlabPool:
                                         daemon=True, name="knn-slab-promote")
         self._thread.start()
 
+    # ------------------------------------------------------- keys & routes
+
+    @staticmethod
+    def _as_key(s):
+        """Normalize a caller's slab reference to a pool key: bare ints
+        for the legacy single-index pool, (tenant, slab) tuples for a
+        multi-tenant one."""
+        return (s[0], int(s[1])) if isinstance(s, tuple) else int(s)
+
+    def register(self, tenant, source: SlabSource, engine_factory) -> None:
+        """Add (or replace) a tenant's cold source + engine factory.
+        Registration happens at engine construction, before that
+        tenant's keys circulate — routes are read-mostly after."""
+        with self._cv:
+            self._routes[tenant] = (source, engine_factory)
+
+    def _route(self, key):  # lsk: holds[_cv]
+        """(tenant, local slab, source, factory) for a pool key."""
+        if isinstance(key, tuple):
+            tenant, slab = key
+        else:
+            tenant, slab = None, int(key)
+        src, fac = self._routes[tenant]
+        return tenant, slab, src, fac
+
+    def _tacct(self, key):  # lsk: holds[_cv]
+        """The per-tenant counter dict for a tuple key (lazily created);
+        None for legacy int keys — single-index pools pay nothing."""
+        if not isinstance(key, tuple):
+            return None
+        acct = self._tenants.get(key[0])
+        if acct is None:
+            acct = self._tenants[key[0]] = {
+                "promotions": 0, "evictions": 0, "device_hits": 0,
+                "host_hits": 0, "cold_reads": 0, "prefetch_enqueued": 0,
+                "stream_stalls": 0, "stream_stall_seconds": 0.0}
+        return acct
+
     # ----------------------------------------------------------- accounting
 
     def _next_tick(self) -> int:  # lsk: holds[_cv]
         self._tick += 1
         return self._tick
 
-    def _note_stall(self, seconds: float) -> None:  # lsk: holds[_cv]
+    def _note_stall(self, seconds: float, key=None):  # lsk: holds[_cv]
         self.stream_stalls += 1
         self.stream_stall_seconds += max(0.0, float(seconds))
+        acct = self._tacct(key)
+        if acct is not None:
+            acct["stream_stalls"] += 1
+            acct["stream_stall_seconds"] += max(0.0, float(seconds))
 
-    def stall_totals(self) -> tuple:
+    def stall_totals(self, tenant=None) -> tuple:
         """(stalls, cumulative stall seconds) — the drift guard's cheap
-        sample, without building the full stats dict."""
+        sample, without building the full stats dict. ``tenant`` narrows
+        to one tenant's share of a shared pool."""
         with self._cv:
-            return self.stream_stalls, self.stream_stall_seconds
+            if tenant is None:
+                return self.stream_stalls, self.stream_stall_seconds
+            acct = self._tenants.get(tenant)
+            if acct is None:
+                return 0, 0.0
+            return acct["stream_stalls"], acct["stream_stall_seconds"]
 
-    def _host_put(self, slab: int, rows) -> None:  # lsk: holds[_cv]
+    def _host_put(self, key, rows) -> None:  # lsk: holds[_cv]
         """Insert/refresh a slab's rows in the host tier; trim LRU past
-        the cap. Device-resident slabs keep their own row reference
+        the slab-count cap and/or the byte cap (``--host-pool-bytes`` —
+        the byte form keeps mixed-size tenant slabs from blowing the
+        tier; the newest insert always survives, like the device tier's
+        overcommit). Device-resident slabs keep their own row reference
         (``engine.host_points``), so trimming here never loses data —
         worst case the cold tier resupplies."""
-        self._host.pop(slab, None)
-        self._host[slab] = rows
-        if self._host_cap > 0:
-            while len(self._host) > self._host_cap:
-                victim = next(iter(self._host))
-                del self._host[victim]
-                self.host_evictions += 1
+        old = self._host.pop(key, None)
+        if old is not None:
+            self._host_bytes -= int(getattr(old, "nbytes", 0))
+        self._host[key] = rows
+        self._host_bytes += int(getattr(rows, "nbytes", 0))
+        while ((self._host_cap > 0 and len(self._host) > self._host_cap)
+               or (self._host_bytes_cap > 0
+                   and self._host_bytes > self._host_bytes_cap
+                   and len(self._host) > 1)):
+            victim = next(iter(self._host))
+            self._host_bytes -= int(
+                getattr(self._host[victim], "nbytes", 0))
+            del self._host[victim]
+            self.host_evictions += 1
 
     def _evict_to_fit(self, new_bytes: int) -> None:  # lsk: holds[_cv]
         """Evict LRU unpinned device slabs until ``new_bytes`` more fit
@@ -321,6 +400,9 @@ class SlabPool:
             ent = self._device.pop(s)
             self._device_bytes -= ent.bytes
             self.evictions += 1
+            acct = self._tacct(s)
+            if acct is not None:
+                acct["evictions"] += 1
             rows = getattr(ent.engine, "host_points", None)
             if rows is not None:
                 self._host_put(s, rows)
@@ -355,6 +437,7 @@ class SlabPool:
         an in-flight promotion it parked behind) is a counted stall unless
         ``count_stall=False`` (warmup/prefetch — data motion the stream
         never waited on)."""
+        slab = self._as_key(slab)
         t0 = None
         while True:
             with self._cv:
@@ -363,8 +446,11 @@ class SlabPool:
                     ent.tick = self._next_tick()
                     if t0 is None:
                         self.device_hits += 1
+                        acct = self._tacct(slab)
+                        if acct is not None:
+                            acct["device_hits"] += 1
                     elif count_stall:
-                        self._note_stall(self._clock() - t0)
+                        self._note_stall(self._clock() - t0, slab)
                     return ent.engine
                 if slab in self._promoting:
                     # another thread (usually the promotion worker) is
@@ -393,41 +479,56 @@ class SlabPool:
             self._device_bytes += int(eng.device_bytes)
             self._promoting.discard(slab)
             self.promotions += 1
+            acct = self._tacct(slab)
+            if acct is not None:
+                acct["promotions"] += 1
             if count_stall:
-                self._note_stall(self._clock() - t0)
+                self._note_stall(self._clock() - t0, slab)
             self._cv.notify_all()
         return eng
 
     def acquire(self, slabs) -> dict:
-        """Ensure every slab of a routed set is resident; {slab: engine}."""
-        return {int(s): self.ensure(int(s)) for s in slabs}
+        """Ensure every slab of a routed set is resident; {key: engine}."""
+        return {self._as_key(s): self.ensure(s) for s in slabs}
 
-    def _build(self, slab: int):
+    def _build(self, key):
         """Materialize rows (host tier first, cold source on miss) and
-        build the slab's engine. Runs with NO pool lock held."""
-        b, _e = self._source.bounds[slab]
+        build the slab's engine. Runs with NO pool lock held (the brief
+        route/host-tier lookups take the lock; the read + factory do
+        not)."""
         with self._cv:
-            rows = self._host.get(slab)
+            _tenant, slab, src, fac = self._route(key)
+            b, _e = src.bounds[slab]
+            rows = self._host.get(key)
             if rows is not None:
-                self._host.pop(slab)
-                self._host[slab] = rows  # move-to-end = LRU refresh
+                self._host.pop(key)
+                self._host[key] = rows  # move-to-end = LRU refresh
                 self.host_hits += 1
+                acct = self._tacct(key)
+                if acct is not None:
+                    acct["host_hits"] += 1
         if rows is None:
-            rows = self._source.read(slab)
+            rows = src.read(slab)
             with self._cv:
                 self.cold_reads += 1
-                self._host_put(slab, rows)
-        self._maybe_fault(slab)
-        return self._factory(slab, rows, b)
+                acct = self._tacct(key)
+                if acct is not None:
+                    acct["cold_reads"] += 1
+                self._host_put(key, rows)
+        self._maybe_fault(key)
+        return fac(slab, rows, b)
 
-    def _maybe_fault(self, slab: int) -> None:
+    def _maybe_fault(self, key) -> None:
         """Deterministic promotion faults (serve/faults.py): ``latency``
         slows the upload (the slow-promotion stall drill), any other op
         fails it — both on the same seeded grammar the HTTP handlers
-        use, keyed as ``PROMOTE /slab/<id>``."""
+        use, keyed as ``PROMOTE /slab/<id>`` (int keys) or
+        ``PROMOTE /slab/<tenant>/<id>`` (tenant keys)."""
         if self._faults is None or not self._faults.active():
             return
-        spec = self._faults.decide(f"/slab/{slab}", "PROMOTE")
+        path = (f"/slab/{key[0]}/{key[1]}" if isinstance(key, tuple)
+                else f"/slab/{key}")
+        spec = self._faults.decide(path, "PROMOTE")
         if spec is None:
             return
         if spec.op == "latency":
@@ -447,7 +548,7 @@ class SlabPool:
             if self._closed:
                 return
             for s in slabs:
-                s = int(s)
+                s = self._as_key(s)
                 ent = self._device.get(s)
                 if ent is not None:
                     # a hint declares the WHOLE set hot: refresh resident
@@ -459,6 +560,9 @@ class SlabPool:
                     continue
                 self._queued.add(s)
                 todo.append(s)
+                acct = self._tacct(s)
+                if acct is not None:
+                    acct["prefetch_enqueued"] += 1
             self.prefetch_enqueued += len(todo)
         for s in todo:
             self._pq.put(s)
@@ -492,9 +596,9 @@ class SlabPool:
         read these rows, so the first promotions should not re-read the
         cold tier for them."""
         with self._cv:
-            self._host_put(int(slab), rows)
+            self._host_put(self._as_key(slab), rows)
 
-    def warm_fill(self, slabs, est_bytes: int) -> list[int]:
+    def warm_fill(self, slabs, est_bytes: int) -> list:
         """Promote slabs in order until the next would exceed the budget
         (``est_bytes`` = one slab's footprint; all pool slabs share a
         shape class, so one estimate covers them). Synchronous and
@@ -502,14 +606,15 @@ class SlabPool:
         started."""
         done = []
         for s in slabs:
+            s = self._as_key(s)
             with self._cv:
                 if s in self._device:
                     continue
                 if (self._budget > 0
                         and self._device_bytes + est_bytes > self._budget):
                     break
-            self.ensure(int(s), count_stall=False)
-            done.append(int(s))
+            self.ensure(s, count_stall=False)
+            done.append(s)
         return done
 
     def wait_idle(self, timeout_s: float = 30.0) -> bool:
@@ -538,7 +643,13 @@ class SlabPool:
         with self._cv:
             return [ent.engine for ent in self._device.values()]
 
-    def resident_slabs(self) -> list[int]:
+    def resident_items(self) -> list:
+        """[(key, engine)] for every device-resident slab — per-tenant
+        facades filter this to their own keys."""
+        with self._cv:
+            return [(k, ent.engine) for k, ent in self._device.items()]
+
+    def resident_slabs(self) -> list:
         with self._cv:
             return sorted(self._device)
 
@@ -550,13 +661,16 @@ class SlabPool:
 
     def stats(self) -> dict:
         with self._cv:
-            return {
-                "num_slabs": self._source.num_slabs,
+            out = {
+                "num_slabs": sum(src.num_slabs
+                                 for src, _fac in self._routes.values()),
                 "device_resident": len(self._device),
                 "host_resident": len(self._host),
                 "device_bytes_used": self._device_bytes,
                 "device_budget_bytes": self._budget,
                 "host_pool_slabs": self._host_cap,
+                "host_pool_bytes": self._host_bytes_cap,
+                "host_bytes_used": self._host_bytes,
                 "resident_slabs": sorted(self._device),
                 "pinned_slabs": sorted(self._pins),
                 "promotions": self.promotions,
@@ -573,6 +687,24 @@ class SlabPool:
                 "stream_stalls": self.stream_stalls,
                 "stream_stall_seconds": round(self.stream_stall_seconds, 6),
             }
+            if self._tenants:
+                per = {}
+                for t, acct in self._tenants.items():
+                    d = dict(acct)
+                    d["stream_stall_seconds"] = round(
+                        d["stream_stall_seconds"], 6)
+                    d["device_resident"] = sum(
+                        1 for k in self._device
+                        if isinstance(k, tuple) and k[0] == t)
+                    d["host_resident"] = sum(
+                        1 for k in self._host
+                        if isinstance(k, tuple) and k[0] == t)
+                    d["pinned"] = sum(
+                        1 for k in self._pins
+                        if isinstance(k, tuple) and k[0] == t)
+                    per[t] = d
+                out["tenants"] = per
+            return out
 
 
 class _StreamHandle:
@@ -617,8 +749,9 @@ class StreamingKnnEngine:
     """
 
     def __init__(self, path: str | None = None, *, points=None,
-                 num_slabs: int, k: int, device_slab_budget: int = 0,
-                 host_pool_slabs: int = 0, prefetch_depth: int = 1,
+                 num_slabs: int = 0, k: int, device_slab_budget: int = 0,
+                 host_pool_slabs: int = 0, host_pool_bytes: int = 0,
+                 prefetch_depth: int = 1,
                  mesh=None, engine: str = "auto", bucket_size: int = 0,
                  max_radius: float = math.inf, max_batch: int = 1024,
                  min_batch: int = 8, merge: str = "auto",
@@ -629,6 +762,11 @@ class StreamingKnnEngine:
                  source_wire: str = "d16",
                  source_throttle_bps: float | None = None,
                  skip_cold_stall_limit: float = 0.25,
+                 source: SlabSource | None = None,
+                 pool: SlabPool | None = None,
+                 tenant: str | None = None,
+                 shared_exec_cache=None, pad_shard_rows: int = 0,
+                 timers: PhaseTimers | None = None,
                  clock=time.perf_counter):
         from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
         from mpi_cuda_largescaleknn_tpu.parallel.ring import resolve_engine
@@ -640,10 +778,16 @@ class StreamingKnnEngine:
         if emit not in ("final", "candidates"):
             raise ValueError(f"emit must be 'final' or 'candidates', "
                              f"got {emit!r}")
-        self._source = SlabSource(path=path, points=points,
-                                  url=source_url, num_slabs=num_slabs,
-                                  wire=source_wire,
-                                  throttle_bps=source_throttle_bps)
+        if pool is not None and tenant is None:
+            raise ValueError("a shared pool= needs a tenant= namespace "
+                             "for this engine's (tenant, slab) keys")
+        if source is not None:
+            self._source = source
+        else:
+            self._source = SlabSource(path=path, points=points,
+                                      url=source_url, num_slabs=num_slabs,
+                                      wire=source_wire,
+                                      throttle_bps=source_throttle_bps)
         self.num_slabs = self._source.num_slabs
         self.n_points = self._source.n_total
         self.dim = self._source.dim
@@ -656,6 +800,8 @@ class StreamingKnnEngine:
         self.prefetch_depth = int(prefetch_depth)
         self.device_slab_budget = int(device_slab_budget)
         self.host_pool_slabs = int(host_pool_slabs)
+        self.host_pool_bytes = int(host_pool_bytes)
+        self.tenant = tenant
         self._clock = clock
         #: never retains host rows itself (the pool's tiers do) — the
         #: /slab_rows pull path needs a single contiguous array, which a
@@ -663,9 +809,14 @@ class StreamingKnnEngine:
         self.host_points = None
         self.mesh = mesh if mesh is not None else get_mesh(None)
         #: shared accounting sink: every slab engine counts fetch/result/
-        #: tile totals here, so eviction never zeroes the /stats surface
-        self.timers = PhaseTimers()
-        self._exec_cache = ExecutableCache()
+        #: tile totals here, so eviction never zeroes the /stats surface.
+        #: A multi-tenant facade passes ONE timers + executable cache to
+        #: every tenant view, so compiled programs (and their counters)
+        #: are shared across tenants — tenant count never becomes
+        #: compile count
+        self.timers = timers if timers is not None else PhaseTimers()
+        self._exec_cache = (shared_exec_cache if shared_exec_cache
+                            is not None else ExecutableCache())
         self.cert_slack = routing_cert_slack(self.dim)
         self._meta_lock = threading.Lock()
         self._engine_name: guarded_by("_meta_lock") = resolve_engine(engine)
@@ -688,20 +839,41 @@ class StreamingKnnEngine:
 
         num_shards = self.mesh.shape[AXIS]
         max_slab = max(e - b for b, e in self._source.bounds)
-        self._pad_shard = -(-max_slab // num_shards)
+        #: one shape class across the POOL: at least this engine's
+        #: largest slab, or a caller-supplied class (the multi-tenant
+        #: facade passes the max over every tenant so the shared cache
+        #: hits across all of them)
+        self._pad_shard = max(int(pad_shard_rows),
+                              -(-max_slab // num_shards))
         self._engine_kw = dict(
             bucket_size=bucket_size, max_radius=max_radius,
             max_batch=max_batch, min_batch=min_batch, merge=merge,
             query_buckets=query_buckets, score_dtype=score_dtype)
-        self._pool = SlabPool(
-            self._source, self._make_engine,
-            device_budget_bytes=device_slab_budget,
-            host_pool_slabs=host_pool_slabs, faults=faults, clock=clock)
+        if pool is not None:
+            self._pool = pool
+            self._owns_pool = False
+            self._pool.register(tenant, self._source, self._make_engine)
+        else:
+            # a standalone tenant-keyed engine registers its source under
+            # the tenant namespace ONLY (every pool key is (tenant, slab));
+            # the legacy None route exists just for bare-int keys
+            self._pool = SlabPool(
+                None if tenant is not None else self._source,
+                self._make_engine,
+                device_budget_bytes=device_slab_budget,
+                host_pool_slabs=host_pool_slabs,
+                host_pool_bytes=host_pool_bytes, faults=faults,
+                clock=clock)
+            self._owns_pool = True
+            if tenant is not None:
+                self._pool.register(tenant, self._source,
+                                    self._make_engine)
         #: per-slab routing boxes (the in-process PodBoundsTable): f64
         #: lo/hi per non-empty slab, +inf lower bound for empty ones.
         #: The scan's rows seed the pool's host tier as they stream by —
         #: the first promotions then re-read RAM, not the cold source
-        aabbs = self._source.scan_aabbs(sink=self._pool.seed_host)
+        aabbs = self._source.scan_aabbs(
+            sink=lambda s, rows: self._pool.seed_host(self._pkey(s), rows))
         self.slab_aabbs = aabbs
         self._nonempty = np.array([a["count"] > 0 for a in aabbs], bool)
         self._slab_lo = np.array([a["lo"] if a["lo"] is not None
@@ -714,7 +886,7 @@ class StreamingKnnEngine:
         # resolved config as the template every sibling shares (all slab
         # engines are built from the same knobs + shape class)
         first = int(np.argmax(self._nonempty))
-        t = self._pool.ensure(first, count_stall=False)
+        t = self._pool.ensure(self._pkey(first), count_stall=False)
         self._template_slab = first
         self.max_batch = t.max_batch
         self.shape_buckets = list(t.shape_buckets)
@@ -732,6 +904,31 @@ class StreamingKnnEngine:
         #: process; routed hosts wrap it with emit='candidates')
         self.process_index = 0
         self.process_count = 1
+
+    # ---------------------------------------------------------- pool keying
+
+    def _pkey(self, slab: int):
+        """This engine's pool key for a local slab id: bare ints for an
+        owned single-index pool, (tenant, slab) in a shared pool."""
+        return (self.tenant, int(slab)) if self.tenant is not None \
+            else int(slab)
+
+    def _pkeys(self, slabs) -> list:
+        return [self._pkey(s) for s in slabs]
+
+    def _resident_local(self) -> set:
+        """This engine's device-resident LOCAL slab ids (a shared pool
+        holds other tenants' keys too — filter to ours)."""
+        if self.tenant is None:
+            return set(self._pool.resident_slabs())
+        return {k[1] for k in self._pool.resident_slabs()
+                if isinstance(k, tuple) and k[0] == self.tenant}
+
+    def _my_engines(self) -> list:
+        if self.tenant is None:
+            return self._pool.resident_engines()
+        return [e for k, e in self._pool.resident_items()
+                if isinstance(k, tuple) and k[0] == self.tenant]
 
     # ------------------------------------------------------------ engine mgmt
 
@@ -781,7 +978,7 @@ class StreamingKnnEngine:
                     f"engine '{self._engine_name}' has no fallback")
             self._engine_name = "tiled"
             self._degraded_reason = reason
-        for eng in self._pool.resident_engines():
+        for eng in self._my_engines():
             if eng.can_degrade():
                 eng.degrade(reason)
 
@@ -789,7 +986,7 @@ class StreamingKnnEngine:
         with self._meta_lock:
             self._launch_workers = max(1, int(n))
             n = self._launch_workers
-        for eng in self._pool.resident_engines():
+        for eng in self._my_engines():
             eng.set_launch_workers(n)
 
     def warmup(self) -> dict:
@@ -797,13 +994,16 @@ class StreamingKnnEngine:
         slab engine reuses them), then fill the remaining device budget
         with slabs in row order. Returns the template's warmup dict plus
         the warm-fill summary."""
-        t = self._pool.ensure(self._template_slab, count_stall=False)
+        t = self._pool.ensure(self._pkey(self._template_slab),
+                              count_stall=False)
         info = t.warmup()
         filled = self._pool.warm_fill(
-            [s for s in range(self.num_slabs)
-             if self._nonempty[s] and s != self._template_slab],
+            self._pkeys(s for s in range(self.num_slabs)
+                        if self._nonempty[s] and s != self._template_slab),
             self.slab_device_bytes)
-        info["warm_slabs"] = sorted([self._template_slab] + filled)
+        info["warm_slabs"] = sorted(
+            [self._template_slab]
+            + [k[1] if isinstance(k, tuple) else k for k in filled])
         return info
 
     # ----------------------------------------------------------------- routing
@@ -847,7 +1047,8 @@ class StreamingKnnEngine:
             return
         _lb, want = self._wave1_want(q)
         self.timers.count("prefetch_hints", 1)
-        self._pool.prefetch(np.nonzero(want.any(axis=0))[0].tolist())
+        self._pool.prefetch(
+            self._pkeys(np.nonzero(want.any(axis=0))[0].tolist()))
 
     # --------------------------------------------------------------- query API
 
@@ -864,7 +1065,7 @@ class StreamingKnnEngine:
         collapses recall without saving the churn. Counted in
         ``skip_cold_refusals``; rides the injectable clock."""
         now = self._clock()
-        _stalls, stall_s = self._pool.stall_totals()
+        _stalls, stall_s = self._pool.stall_totals(self.tenant)
         with self._meta_lock:
             ring = self._stall_ring
             ring.append((now, stall_s))
@@ -909,7 +1110,7 @@ class StreamingKnnEngine:
         handle.skip_cold = (plan is not None and plan.stream_skip_cold
                             and self._skip_cold_admit())
         if handle.skip_cold:
-            resident = set(self._pool.resident_slabs())
+            resident = self._resident_local()
             first = np.argmin(lb, axis=1)
             must = set(int(s) for i, s in enumerate(first)
                        if np.isfinite(lb[i, s]))
@@ -919,19 +1120,19 @@ class StreamingKnnEngine:
                 # serve this batch from what is warm; warm the rest UNDER
                 # its compute for the escalation pass / future batches
                 want[:, deferred] = False
-                self._pool.prefetch(deferred)
+                self._pool.prefetch(self._pkeys(deferred))
         wave = [(s, np.nonzero(want[:, s])[0])
                 for s in range(self.num_slabs) if want[:, s].any()]
         sids = [s for s, _rows in wave]
-        self._pool.pin(sids)
+        self._pool.pin(self._pkeys(sids))
         handle.pinned.update(sids)
         # hand the whole wave to the promotion thread first: a multi-slab
         # cold wave then builds one slab on this thread while the next
         # builds asynchronously, instead of strictly serial stalls
-        self._pool.prefetch(sids)
+        self._pool.prefetch(self._pkeys(sids))
         try:
             for s, rows in wave:
-                eng = self._pool.ensure(s)
+                eng = self._pool.ensure(self._pkey(s))
                 handle.subs.append((
                     s, rows, eng,
                     eng.dispatch(queries[rows]) if plan is None
@@ -940,7 +1141,7 @@ class StreamingKnnEngine:
         except BaseException:
             # a failed promotion/dispatch must not leak this batch's pins
             # — leaked pins would make the slabs permanently unevictable
-            self._pool.unpin(handle.pinned)
+            self._pool.unpin(self._pkeys(handle.pinned))
             handle.pinned = set()
             raise
         handle.lb, handle.visited = lb, visited
@@ -952,7 +1153,7 @@ class StreamingKnnEngine:
             depth = [int(s) for s in order[:self.prefetch_depth]
                      if np.isfinite(rest[s])]
             if depth:
-                self._pool.prefetch(depth)
+                self._pool.prefetch(self._pkeys(depth))
         return handle
 
     def _complete_fold(self, handle: _StreamHandle):
@@ -987,7 +1188,7 @@ class StreamingKnnEngine:
                     break
                 sids = [s for s in range(self.num_slabs) if need[:, s].any()]
                 if skip_cold:
-                    resident = set(self._pool.resident_slabs())
+                    resident = self._resident_local()
                     cold = [s for s in sids if s not in resident]
                     if cold:
                         # the recall sacrifice (d) makes: these bounds
@@ -999,7 +1200,7 @@ class StreamingKnnEngine:
                                           len(cold))
                         for s in cold:
                             visited[need[:, s], s] = True
-                        self._pool.prefetch(cold)
+                        self._pool.prefetch(self._pkeys(cold))
                         sids = [s for s in sids if s in resident]
                         if not sids:
                             continue
@@ -1010,20 +1211,21 @@ class StreamingKnnEngine:
                 wave += 1
                 new = [s for s in sids if s not in handle.pinned]
                 if new:
-                    self._pool.pin(new)
+                    self._pool.pin(self._pkeys(new))
                     handle.pinned.update(new)
-                    self._pool.prefetch(new)  # overlap multi-slab waves
+                    # overlap multi-slab waves
+                    self._pool.prefetch(self._pkeys(new))
                 subs = []
                 for s in sids:
                     rows = np.nonzero(need[:, s])[0]
-                    eng = self._pool.ensure(s)
+                    eng = self._pool.ensure(self._pkey(s))
                     subs.append((
                         s, rows, eng,
                         eng.dispatch(q[rows]) if plan is None
                         else eng.dispatch(q[rows], plan=plan)))
                     visited[rows, s] = True
         finally:
-            self._pool.unpin(handle.pinned)
+            self._pool.unpin(self._pkeys(handle.pinned))
             handle.pinned = set()
         self.timers.hist("stream_batch_seconds").record(
             self._clock() - handle.t0)
@@ -1070,13 +1272,19 @@ class StreamingKnnEngine:
         return self.complete_candidates(self.dispatch(queries))
 
     def close(self) -> None:
-        self._pool.close()
+        if self._owns_pool:
+            self._pool.close()
 
     # ------------------------------------------------------------------ stats
 
     def stats(self) -> dict:
         pool = self._pool.stats()
         cache = self._exec_cache.stats()
+        if self.tenant is None:
+            my_resident = pool["device_resident"]
+        else:
+            mine = pool.get("tenants", {}).get(self.tenant, {})
+            my_resident = int(mine.get("device_resident", 0))
         with self._meta_lock:
             engine_name = self._engine_name
             degraded_reason = self._degraded_reason
@@ -1103,7 +1311,7 @@ class StreamingKnnEngine:
             # the routing surface a pod front end folds over: one box per
             # SLAB (the streaming engine's own routing granularity)
             "shard_bounds": self.slab_aabbs,
-            "device_bytes": self.slab_device_bytes * pool["device_resident"],
+            "device_bytes": self.slab_device_bytes * my_resident,
             "max_batch": self.max_batch,
             "bucket_size": self.bucket_size,
             "shape_buckets": list(self.shape_buckets),
@@ -1129,7 +1337,9 @@ class StreamingKnnEngine:
                 pool,
                 slab_device_bytes=self.slab_device_bytes,
                 prefetch_depth=self.prefetch_depth,
-                prefetch_hints=self.timers.counter("prefetch_hints")),
+                prefetch_hints=self.timers.counter("prefetch_hints"),
+                **({} if self.tenant is None
+                   else {"tenant": self.tenant})),
             "streaming": {
                 "num_slabs": self.num_slabs,
                 "batches": self.timers.counter("stream_batches"),

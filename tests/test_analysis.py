@@ -535,6 +535,7 @@ class TestRepoGate:
             "serve/replica.py": {"ReplicaSet", "ReplicaManager"},
             "serve/server.py": {"ServingMetrics"},
             "serve/slabpool.py": {"SlabPool", "StreamingKnnEngine"},
+            "serve/tenancy.py": {"TenantRegistry", "TenantQuotas"},
             "serve/wire.py": {"WireNegotiator", "WireStats"},
         }
         for rel, expected in want.items():

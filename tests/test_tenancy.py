@@ -1,0 +1,557 @@
+"""Multi-index tenancy (serve/tenancy.py): many indexes, one byte budget.
+
+Five layers of coverage:
+
+- ``TenantSpec`` / ``TenantRegistry`` config + routing-table units: name
+  validation (tenant names ride in URLs), sorted enumeration, and the
+  404 contract (``UnknownTenantError``, never a silent fallthrough to
+  someone else's index).
+- ``TenantQuotas`` admission slices with a no-jax controller: per-tenant
+  caps over one global row budget, rollback of the tenant reservation
+  when the GLOBAL cap rejects, 0 = unsliced, Retry-After surfaced on the
+  raised ``OverloadError``, and the stats shape the /stats quota block
+  serializes.
+- Shared ``SlabPool`` with FAKE engines (no jax, no sleeps): (tenant,
+  slab) tuple keys routing to each tenant's registered source + factory,
+  per-tenant hit/promotion/eviction/stall accounting, and eviction
+  FAIRNESS — a hot tenant's recently-touched pages survive a cold
+  tenant's churn through the same budget.
+- ``MultiTenantEngine`` with real engines: per-tenant BITWISE parity
+  against isolated single-tenant ``StreamingKnnEngine`` twins across a
+  device-budget matrix (the exactness contract: the shared pool changes
+  WHEN a slab is resident, never what its engine computes), and the
+  compile-count-flat gate — ≥3 tenants warm up through ONE shared
+  executable cache at a single tenant's compile cost.
+- HTTP surface through a real ``KnnServer``: ``/v1/<tenant>/knn`` (plus
+  the ``tenant`` JSON field and ``X-Knn-Tenant`` header), legacy
+  ``/knn`` resolving to the default tenant byte-identically, unknown
+  tenants 404ing with the tenant list, per-tenant quota 429 +
+  Retry-After, the per-tenant /stats namespace and ``{tenant=}`` metric
+  labels — and a single-index server showing NONE of that surface (the
+  wire format is unchanged for existing deployments).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+K = 5
+
+
+def _tenant_points(i: int, n: int = 240):
+    """Each tenant gets its OWN point cloud (different seed and size —
+    different sizes exercise the shared pad-shape class)."""
+    from tests.oracle import random_points
+
+    return random_points(n + 30 * i, seed=100 + i, scale=0.5)
+
+
+# ------------------------------------------------------ spec + registry
+
+
+class TestTenantSpecAndRegistry:
+    def test_spec_rejects_url_hostile_names(self):
+        from mpi_cuda_largescaleknn_tpu.serve.tenancy import TenantSpec
+
+        with pytest.raises(ValueError, match="bad tenant name"):
+            TenantSpec("", points=np.zeros((4, 3)))
+        with pytest.raises(ValueError, match="bad tenant name"):
+            TenantSpec("a/b", points=np.zeros((4, 3)))
+
+    def test_registry_roundtrip_and_sorted_names(self):
+        from mpi_cuda_largescaleknn_tpu.serve.tenancy import TenantRegistry
+
+        reg = TenantRegistry()
+        reg.add("zeta", "engine-z")
+        reg.add("alpha", "engine-a")
+        assert reg.get("alpha") == "engine-a"
+        assert reg.names() == ["alpha", "zeta"]  # sorted, not insertion
+        assert "zeta" in reg and "nope" not in reg
+        assert len(reg) == 2
+
+    def test_unknown_tenant_raises_keyerror_subclass(self):
+        from mpi_cuda_largescaleknn_tpu.serve.tenancy import (
+            TenantRegistry,
+            UnknownTenantError,
+        )
+
+        reg = TenantRegistry()
+        with pytest.raises(UnknownTenantError):
+            reg.get("stranger")
+        assert issubclass(UnknownTenantError, KeyError)
+
+
+# -------------------------------------------------------------- quotas
+
+
+class TestTenantQuotas:
+    def _quotas(self, global_rows=100, **kw):
+        from mpi_cuda_largescaleknn_tpu.serve.admission import (
+            AdmissionController,
+        )
+        from mpi_cuda_largescaleknn_tpu.serve.tenancy import TenantQuotas
+
+        ctrl = AdmissionController(max_queue_rows=global_rows)
+        return ctrl, TenantQuotas(ctrl, **kw)
+
+    def test_over_quota_rejects_with_retry_after(self):
+        ctrl, q = self._quotas(quotas={"a": 10}, retry_after_s=0.25)
+        q.admit("a", 8)
+        with pytest.raises(Exception, match="over quota") as e:
+            q.admit("a", 8)  # 8 + 8 > 10
+        assert e.value.retry_after_s == pytest.approx(0.25)
+        assert q.stats()["tenants"]["a"]["rejected"] == 1
+        # the reservation that DID land is still held and releasable
+        assert q.stats()["tenants"]["a"]["inflight_rows"] == 8
+        q.release("a", 8)
+        assert q.stats()["tenants"]["a"]["inflight_rows"] == 0
+
+    def test_zero_quota_means_unsliced_global_cap_only(self):
+        ctrl, q = self._quotas(global_rows=20)
+        q.admit("free", 20)  # quota 0 -> only the global cap applies
+        from mpi_cuda_largescaleknn_tpu.serve.admission import OverloadError
+
+        with pytest.raises(OverloadError, match="queue full"):
+            q.admit("free", 1)
+        q.release("free", 20)
+
+    def test_global_reject_rolls_back_tenant_reservation(self):
+        ctrl, q = self._quotas(global_rows=10, quotas={"a": 50})
+        ctrl.admit(8)  # someone else holds most of the global budget
+        from mpi_cuda_largescaleknn_tpu.serve.admission import OverloadError
+
+        with pytest.raises(OverloadError):
+            q.admit("a", 8)  # under tenant quota, over GLOBAL cap
+        # the tenant slice was rolled back — a smaller request still fits
+        assert q.stats()["tenants"]["a"]["inflight_rows"] == 0
+        q.admit("a", 2)
+        q.release("a", 2)
+        ctrl.release(8)
+
+    def test_one_tenant_cannot_starve_another(self):
+        ctrl, q = self._quotas(global_rows=100, default_quota_rows=60)
+        q.admit("hog", 60)
+        with pytest.raises(Exception, match="over quota"):
+            q.admit("hog", 1)
+        q.admit("quiet", 40)  # the hog left room for everyone else
+        q.release("hog", 60)
+        q.release("quiet", 40)
+
+    def test_set_quota_and_context_manager(self):
+        ctrl, q = self._quotas()
+        q.set_quota("a", 5)
+        assert q.quota("a") == 5 and q.quota("b") == 0
+        with q.admitted_rows("a", 5):
+            assert q.stats()["tenants"]["a"]["inflight_rows"] == 5
+        assert q.stats()["tenants"]["a"]["inflight_rows"] == 0
+        assert ctrl.inflight_rows() == 0
+
+
+# ----------------------------------------------- shared pool (fake engines)
+
+
+class _FakeEngine:
+    def __init__(self, key, rows, device_bytes):
+        self.key = key
+        self.host_points = rows
+        self.device_bytes = device_bytes
+
+
+class _TenantPoolRig:
+    """A multi-tenant SlabPool over fakes: two registered tenants,
+    injectable counter clock, per-(tenant, slab) build log."""
+
+    def __init__(self, slab_bytes=100, build_cost=0.5, **pool_kw):
+        from mpi_cuda_largescaleknn_tpu.serve.slabpool import (
+            SlabPool,
+            SlabSource,
+        )
+
+        self.now = [0.0]
+        self.built = []
+        self.pool = SlabPool(clock=lambda: self.now[0], **pool_kw)
+
+        def mk_factory(tenant):
+            def factory(slab, rows, begin):
+                self.now[0] += build_cost
+                self.built.append((tenant, slab))
+                return _FakeEngine((tenant, slab), rows, slab_bytes)
+            return factory
+
+        for i, tenant in enumerate(("hot", "cold")):
+            n = 40 + 8 * i
+            src = SlabSource(points=np.arange(n * 3, dtype=np.float32)
+                             .reshape(n, 3), num_slabs=4)
+            self.pool.register(tenant, src, mk_factory(tenant))
+            setattr(self, f"{tenant}_src", src)
+
+
+class TestSharedPoolTenancy:
+    def test_tuple_keys_route_to_each_tenants_source(self):
+        rig = _TenantPoolRig()
+        e_hot = rig.pool.ensure(("hot", 0))
+        e_cold = rig.pool.ensure(("cold", 0))
+        assert e_hot.key == ("hot", 0) and e_cold.key == ("cold", 0)
+        # same local slab id, DIFFERENT rows: each tenant's own index
+        assert (e_hot.host_points.tobytes()
+                == rig.hot_src.read(0).tobytes())
+        assert (e_cold.host_points.tobytes()
+                == rig.cold_src.read(0).tobytes())
+        assert rig.built == [("hot", 0), ("cold", 0)]
+        rig.pool.close()
+
+    def test_per_tenant_accounting_in_stats(self):
+        rig = _TenantPoolRig(device_budget_bytes=200)  # 2 slabs
+        p = rig.pool
+        p.ensure(("hot", 0))
+        p.ensure(("hot", 0))           # device hit for "hot"
+        p.ensure(("cold", 0))
+        p.ensure(("cold", 1))          # evicts hot/0 (LRU)
+        s = p.stats()
+        assert s["num_slabs"] == 8     # 4 + 4 across both sources
+        t = s["tenants"]
+        assert t["hot"]["promotions"] == 1 and t["hot"]["device_hits"] == 1
+        assert t["hot"]["evictions"] == 1 and t["hot"]["device_resident"] == 0
+        assert t["cold"]["promotions"] == 2 and t["cold"]["evictions"] == 0
+        assert t["cold"]["device_resident"] == 2
+        # stall seconds split per tenant and sum to the pool totals
+        stalls, secs = p.stall_totals()
+        h = p.stall_totals(tenant="hot")
+        c = p.stall_totals(tenant="cold")
+        assert h[0] + c[0] == stalls
+        assert h[1] + c[1] == pytest.approx(secs)
+        p.close()
+
+    def test_eviction_fairness_hot_pages_survive_cold_churn(self):
+        """The fairness contract under skew: a tenant whose pages are
+        re-touched keeps them resident; an idle tenant's churn only
+        cycles the remaining budget (LRU is tenant-blind — recency is
+        the only currency, so activity IS the fair share)."""
+        rig = _TenantPoolRig(device_budget_bytes=300)  # 3 slabs
+        p = rig.pool
+        p.ensure(("hot", 0))
+        for slab in (0, 1, 2, 3, 0, 1, 2, 3):  # cold churns its index
+            rig.now[0] += 1.0
+            p.ensure(("cold", slab))
+            rig.now[0] += 1.0
+            p.ensure(("hot", 0))  # hot re-touches its one page
+        assert ("hot", 0) in p.resident_slabs()  # never evicted
+        t = p.stats()["tenants"]
+        assert t["hot"]["promotions"] == 1 and t["hot"]["evictions"] == 0
+        assert t["cold"]["evictions"] >= 4  # churn stayed in cold's share
+        p.close()
+
+    def test_pins_and_prefetch_use_tuple_keys(self):
+        rig = _TenantPoolRig(device_budget_bytes=100)  # 1 slab
+        p = rig.pool
+        p.pin([("hot", 2)])
+        p.ensure(("hot", 2))
+        p.ensure(("cold", 3))  # pinned hot page overcommits, not evicts
+        assert ("hot", 2) in p.resident_slabs()
+        assert p.stats()["overcommits"] == 1
+        p.unpin([("hot", 2)])
+        p.prefetch([("cold", 1)])
+        assert p.wait_idle(timeout_s=10)
+        assert p.stats()["tenants"]["cold"]["prefetch_enqueued"] == 1
+        p.close()
+
+
+# ------------------------------------------- multi-tenant engine (real jax)
+
+
+@pytest.fixture(scope="module")
+def tenancy_rig():
+    """Three tenants behind one shared pool + AOT cache, and an isolated
+    single-tenant twin per tenant over identical points — the parity
+    references. Both sides canonical: tiled engine, device merge."""
+    from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+    from mpi_cuda_largescaleknn_tpu.serve.slabpool import StreamingKnnEngine
+    from mpi_cuda_largescaleknn_tpu.serve.tenancy import (
+        MultiTenantEngine,
+        TenantSpec,
+    )
+
+    kw = dict(engine="tiled", bucket_size=64, max_batch=32, min_batch=16,
+              merge="device")
+    names = ["t0", "t1", "t2"]
+    points = {n: _tenant_points(i) for i, n in enumerate(names)}
+    mesh = get_mesh(2)
+    shared = MultiTenantEngine(
+        [TenantSpec(n, points=points[n], num_slabs=3) for n in names],
+        k=K, mesh=mesh, prefetch_depth=0, **kw)
+    warm = shared.warmup()
+    twins = {}
+    for n in names:
+        twins[n] = StreamingKnnEngine(points=points[n], num_slabs=3, k=K,
+                                      mesh=mesh, prefetch_depth=0, **kw)
+        twins[n].warmup()
+    yield names, points, shared, warm, twins
+    for t in twins.values():
+        t.close()
+    shared.close()
+
+
+def _probes(pts, seed):
+    rng = np.random.default_rng(seed)
+    return [rng.random((9, 3)).astype(np.float32),
+            pts[:1], pts[31:48]]
+
+
+class TestMultiTenantEngine:
+    def test_per_tenant_bitwise_parity_across_budgets(self, tenancy_rig):
+        """THE acceptance bar: every tenant's answers through the shared
+        pool equal its isolated twin's bytes at budgets {1 slab, half,
+        everything} — dists AND neighbor ids."""
+        names, points, shared, _warm, twins = tenancy_rig
+        slab_b = shared.slab_device_bytes
+        for budget_slabs in (1, 4, 0):  # 0 = unlimited
+            shared.slab_pool.set_device_budget(slab_b * budget_slabs)
+            for i, n in enumerate(names):
+                for q in _probes(points[n], seed=7 + i):
+                    dt, nt = twins[n].query(q)
+                    ds, ns = shared.query(q, tenant=n)
+                    assert np.array_equal(dt, ds), \
+                        f"dists diverge for {n} at budget {budget_slabs}"
+                    assert np.array_equal(nt, ns), \
+                        f"ids diverge for {n} at budget {budget_slabs}"
+        shared.slab_pool.set_device_budget(0)
+
+    def test_compile_count_flat_across_tenants(self, tenancy_rig):
+        """Tenant count never becomes compile count: warming THREE
+        tenants through the shared cache costs no more compiles than one
+        isolated single-tenant engine, and serving all of them after
+        warmup adds zero."""
+        names, points, shared, warm, twins = tenancy_rig
+        single = twins[names[0]].stats()["compile_count"]
+        assert 0 < warm["compile_count"] <= single
+        before = shared.stats()["compile_count"]
+        for n in names:
+            shared.query(points[n][:5], tenant=n)
+        assert shared.stats()["compile_count"] == before
+
+    def test_resolve_and_unknown_tenant(self, tenancy_rig):
+        from mpi_cuda_largescaleknn_tpu.serve.tenancy import (
+            UnknownTenantError,
+        )
+
+        names, points, shared, _warm, _twins = tenancy_rig
+        assert shared.default_tenant == names[0]
+        name, eng = shared.resolve(None)  # legacy /knn route
+        assert name == names[0] and eng.n_points == len(points[names[0]])
+        with pytest.raises(UnknownTenantError):
+            shared.resolve("stranger")
+        with pytest.raises(UnknownTenantError):
+            shared.query(points[names[0]][:2], tenant="stranger")
+
+    def test_dispatch_handle_carries_tenant_namespace(self, tenancy_rig):
+        names, points, shared, _warm, twins = tenancy_rig
+        q = points[names[2]][:4]
+        h = shared.dispatch(q, tenant=names[2])
+        assert h.tenant == names[2] and h.n == 4
+        ds, _ns = shared.complete(h)
+        dt, _nt = twins[names[2]].query(q)
+        assert np.array_equal(dt, ds)
+
+    def test_stats_carry_per_tenant_namespace(self, tenancy_rig):
+        names, points, shared, _warm, _twins = tenancy_rig
+        s = shared.stats()
+        assert s["n_points"] == sum(len(points[n]) for n in names)
+        assert s["default_tenant"] == names[0]
+        assert sorted(s["tenants"]) == sorted(names)
+        for n in names:
+            t = s["tenants"][n]
+            assert t["n_points"] == len(points[n])
+            assert t["num_slabs"] == 3 and t["k"] == K
+
+
+# ------------------------------------------------------------ HTTP surface
+
+
+def _url(server):
+    return f"http://127.0.0.1:{server.server_address[1]}"
+
+
+def _post(base, payload: dict, path="/knn", headers=(), timeout=60):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers=dict({"Content-Type": "application/json"}, **dict(headers)))
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _get(base, path, timeout=30):
+    with urllib.request.urlopen(base + path, timeout=timeout) as resp:
+        body = resp.read()
+    try:
+        return json.loads(body)
+    except ValueError:
+        return body.decode()
+
+
+@pytest.fixture(scope="module")
+def mt_server(tenancy_rig):
+    from mpi_cuda_largescaleknn_tpu.serve.server import build_server
+
+    _names, _points, shared, _warm, _twins = tenancy_rig
+    srv = build_server(shared, port=0, max_delay_s=0.002)
+    srv.ready = True
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield srv
+    srv.close()
+
+
+@pytest.fixture(scope="module")
+def single_server(tenancy_rig):
+    """A single-index server over one of the twins — the wire-format
+    control: no tenant surface may appear."""
+    from mpi_cuda_largescaleknn_tpu.serve.server import build_server
+
+    names, _points, _shared, _warm, twins = tenancy_rig
+    srv = build_server(twins[names[0]], port=0, max_delay_s=0.002)
+    srv.ready = True
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield srv
+    srv.close()
+
+
+class TestTenancyHTTP:
+    def test_v1_route_serves_that_tenants_index(self, tenancy_rig,
+                                                mt_server):
+        names, points, _shared, _warm, twins = tenancy_rig
+        base = _url(mt_server)
+        for n in names:
+            q = points[n][:6]
+            status, resp = _post(base, {"queries": q.tolist(),
+                                        "neighbors": True},
+                                 path=f"/v1/{n}/knn")
+            dt, nt = twins[n].query(q)
+            assert status == 200
+            assert np.array_equal(np.asarray(resp["dists"], np.float32),
+                                  np.asarray(dt, np.float32))
+            assert np.array_equal(np.asarray(resp["neighbors"]),
+                                  np.asarray(nt))
+
+    def test_legacy_route_is_the_default_tenant_bytes(self, tenancy_rig,
+                                                      mt_server):
+        names, points, _shared, _warm, _twins = tenancy_rig
+        base = _url(mt_server)
+        q = points[names[0]][:5].tolist()
+        _s, legacy = _post(base, {"queries": q, "neighbors": True})
+        _s, explicit = _post(base, {"queries": q, "neighbors": True},
+                             path=f"/v1/{names[0]}/knn")
+        assert legacy["dists"] == explicit["dists"]
+        assert legacy["neighbors"] == explicit["neighbors"]
+
+    def test_header_and_json_field_route_like_the_url(self, tenancy_rig,
+                                                      mt_server):
+        names, points, _shared, _warm, _twins = tenancy_rig
+        base = _url(mt_server)
+        n = names[2]
+        q = points[n][:4].tolist()
+        _s, via_url = _post(base, {"queries": q, "neighbors": True},
+                            path=f"/v1/{n}/knn")
+        _s, via_field = _post(base, {"queries": q, "neighbors": True,
+                                     "tenant": n})
+        _s, via_header = _post(base, {"queries": q, "neighbors": True},
+                               headers={"X-Knn-Tenant": n})
+        assert via_field["dists"] == via_url["dists"]
+        assert via_header["dists"] == via_url["dists"]
+        assert via_field["neighbors"] == via_url["neighbors"]
+
+    def test_unknown_tenant_404_lists_tenants(self, tenancy_rig,
+                                              mt_server):
+        names, points, _shared, _warm, _twins = tenancy_rig
+        base = _url(mt_server)
+        q = points[names[0]][:2].tolist()
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(base, {"queries": q}, path="/v1/stranger/knn")
+        assert e.value.code == 404
+        body = json.loads(e.value.read())
+        assert "no such tenant" in body["error"]
+        assert body["tenants"] == sorted(names)
+
+    def test_quota_429_with_retry_after(self, tenancy_rig, mt_server):
+        names, points, _shared, _warm, _twins = tenancy_rig
+        base = _url(mt_server)
+        n = names[1]
+        mt_server.quotas.set_quota(n, 3)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(base, {"queries": points[n][:8].tolist()},
+                      path=f"/v1/{n}/knn")
+            assert e.value.code == 429
+            assert float(e.value.headers["Retry-After"]) > 0
+            assert "over quota" in json.loads(e.value.read())["error"]
+            # other tenants are untouched by n's quota
+            status, _ = _post(base, {"queries": points[names[0]][:8]
+                                     .tolist()},
+                              path=f"/v1/{names[0]}/knn")
+            assert status == 200
+            # and n itself still serves requests under its cap
+            status, _ = _post(base, {"queries": points[n][:3].tolist()},
+                              path=f"/v1/{n}/knn")
+            assert status == 200
+        finally:
+            mt_server.quotas.set_quota(n, 0)
+        st = _get(base, f"/v1/{n}/stats")
+        assert st["quota"]["rejected"] >= 1
+
+    def test_stats_has_per_tenant_namespace(self, tenancy_rig, mt_server):
+        names, _points, _shared, _warm, _twins = tenancy_rig
+        stats = _get(_url(mt_server), "/stats")
+        assert sorted(stats["tenants"]) == sorted(names)
+        for n in names:
+            block = stats["tenants"][n]
+            assert set(block) >= {"server", "quota", "engine"}
+            assert "request_latency" in block["server"]
+            assert set(block["quota"]) >= {"quota_rows", "inflight_rows",
+                                           "rejected"}
+
+    def test_per_tenant_stats_route(self, tenancy_rig, mt_server):
+        names, points, _shared, _warm, _twins = tenancy_rig
+        base = _url(mt_server)
+        st = _get(base, f"/v1/{names[1]}/stats")
+        assert st["tenant"] == names[1]
+        assert st["engine"]["n_points"] == len(points[names[1]])
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(base, "/v1/stranger/stats")
+        assert e.value.code == 404
+        assert json.loads(e.value.read())["tenants"] == sorted(names)
+
+    def test_metrics_carry_tenant_labels(self, tenancy_rig, mt_server):
+        names, points, _shared, _warm, _twins = tenancy_rig
+        base = _url(mt_server)
+        for n in names:  # every tenant has served at least one request
+            _post(base, {"queries": points[n][:2].tolist()},
+                  path=f"/v1/{n}/knn")
+        m = _get(base, "/metrics")
+        for n in names:
+            assert f'knn_requests_total{{tenant="{n}"}}' in m
+            assert f'knn_slab_pool_tenant_resident{{tenant="{n}"' in m
+            assert f'knn_tenant_quota_rows{{tenant="{n}"}}' in m
+        assert 'knn_slab_tenant_promotions_total{tenant="' in m
+        # the unlabeled aggregates still lead each family
+        assert "\nknn_requests_total " in "\n" + m
+
+    def test_single_index_server_shows_no_tenant_surface(self,
+                                                         tenancy_rig,
+                                                         single_server):
+        names, points, _shared, _warm, _twins = tenancy_rig
+        base = _url(single_server)
+        status, _resp = _post(base, {"queries": points[names[0]][:3]
+                                     .tolist()})
+        assert status == 200
+        # tenancy URLs are strangers here — no accidental namespace
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(base, {"queries": points[names[0]][:3].tolist()},
+                  path=f"/v1/{names[0]}/knn")
+        assert e.value.code == 404
+        assert "tenants" not in _get(base, "/stats")
+        assert single_server.quotas is None
+        assert '{tenant="' not in _get(base, "/metrics")

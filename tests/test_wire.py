@@ -574,7 +574,8 @@ def drift_stream():
 class TestSkipColdDriftAdmission:
     def _stall(self, stream, seconds):
         """Pin the pool's cumulative stall clock to a chosen value."""
-        stream._pool.stall_totals = lambda: (1, float(seconds))
+        stream._pool.stall_totals = (
+            lambda tenant=None: (1, float(seconds)))
 
     def test_healthy_pool_admits(self, drift_stream):
         stream, clock = drift_stream

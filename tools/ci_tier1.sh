@@ -67,10 +67,16 @@ if [ "${SERVE_BENCH:-1}" != "0" ] && [ "$rc" -ne 124 ]; then
   # probe parity per pod, exchange bytes-per-row <= 0.45x f32, and the
   # d16 slab handoff being lossless with a paced-transfer seconds
   # ratio <= 0.6x f32
-  timeout -k 10 3300 python tools/serve_smoke.py --duration 2 --trials 3 \
+  # --tenancy-bench adds the multi-index tenancy section
+  # (tenancy_compare): N tenants under zipf-skewed traffic sharing ONE
+  # device byte budget vs N isolated single-tenant servers at equal
+  # total memory — gated on aggregate goodput >= 1.3x isolated, cold
+  # tenant p99 bounded, per-tenant bitwise parity vs the isolated
+  # twins, and compile count staying flat across tenants
+  timeout -k 10 3900 python tools/serve_smoke.py --duration 2 --trials 3 \
       --locality-bench --multihost-bench --kernel-bench --routing-bench \
       --chaos-bench --replica-bench --streaming-bench --recall-bench \
-      --wire-bench \
+      --wire-bench --tenancy-bench \
       --out BENCH_serve.json >/dev/null || { brc=$?; [ "$rc" -eq 0 ] && rc=$brc; }
 fi
 # the lskcheck gate blocks even when the tests pass (and never masks a

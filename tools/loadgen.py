@@ -91,7 +91,8 @@ class _Client:
                 self._conn = None
 
     def post_batch(self, queries: np.ndarray, neighbors: bool,
-                   binary: bool, recall: float | None = None):
+                   binary: bool, recall: float | None = None,
+                   tenant: str | None = None):
         """-> (status, degraded, retry_after_s|None, tier|None).
 
         ``degraded`` is the server's HOST-LOSS exactness flag for a 200
@@ -105,8 +106,11 @@ class _Client:
         ``{"exact": bool, "recall_estimated": float|None, "plan":
         str|None}``. ``retry_after_s`` echoes a Retry-After header so the
         load loop can honor 503/429 backpressure instead of hammering a
-        draining pod."""
+        draining pod. ``tenant`` routes the request to a multi-index
+        server's ``/v1/<tenant>/knn`` namespace (docs/SERVING.md
+        'Multi-index tenancy'); None keeps the legacy ``/knn`` path."""
         tier = None
+        knn_path = f"/v1/{tenant}/knn" if tenant else "/knn"
         if binary:
             # raw f32 xyz triples in, raw f32 distances out — the server's
             # octet-stream format. Skips both sides' JSON encode/decode, so
@@ -116,7 +120,7 @@ class _Client:
                 "neighbors=1" if neighbors else "",
                 f"recall={recall:g}" if recall is not None else "") if o]
             status, payload, headers = self._request(
-                "/knn" + ("?" + "&".join(opts) if opts else ""),
+                knn_path + ("?" + "&".join(opts) if opts else ""),
                 np.ascontiguousarray(queries, np.float32).tobytes(),
                 "application/octet-stream")
             degraded = False
@@ -137,7 +141,7 @@ class _Client:
             if recall is not None:
                 body["recall"] = recall
             status, payload, headers = self._request(
-                "/knn", json.dumps(body).encode(), "application/json")
+                knn_path, json.dumps(body).encode(), "application/json")
             obj = json.loads(payload.decode())
             degraded = (status == 200 and obj.get("exact") is False
                         and "recall_plan" not in obj)
@@ -250,7 +254,9 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
              sweep_period_s: float = 2.0,
              hosts: list[str] | None = None,
              retry_after_cap_s: float = 1.0,
-             recall: float | None = None) -> dict:
+             recall: float | None = None,
+             tenants: list[str] | None = None,
+             tenant_skew: float = 0.0) -> dict:
     """Drive the server; returns the JSON-able report (also the test API).
 
     ``qps > 0`` switches to open loop: the request schedule is fixed at
@@ -290,6 +296,16 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
     forces a tiered slab pool (serve/slabpool.py) through real
     eviction/readmission cycles, where clustered/uniform streams never
     evict again once warm.
+
+    ``tenants`` switches to multi-index mode against a tenanted server
+    (serve/tenancy.py): each request picks a tenant name and posts to
+    ``/v1/<tenant>/knn``. ``tenant_skew`` is the zipf exponent ``a`` of
+    the pick distribution — weight of rank-i tenant is 1/(i+1)^a, so
+    rank 0 is the hot tenant and the tail goes cold as ``a`` grows
+    (0 = uniform). The report then carries a per-tenant
+    availability/p50/p99 split plus a hot/cold rollup (hot = rank 0,
+    cold = everything else aggregated) — the read the tenancy bench
+    uses to bound a cold tenant's p99 under one shared byte budget.
     """
     if workload not in ("uniform", "clustered", "sweep"):
         raise ValueError(f"unknown workload '{workload}'")
@@ -315,13 +331,40 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
     ep_counts = {u: {"requests": 0, "ok": 0, "errors": 0, "degraded": 0,
                      "rejected": 0}
                  for u in endpoints}
+    # multi-index mode: zipf pick weights — rank-i tenant draws
+    # 1/(i+1)^tenant_skew of the traffic (skew 0 = uniform), so rank 0
+    # is the hot tenant and the tail goes cold as the exponent grows
+    tenant_names = list(tenants) if tenants else []
+    if len(set(tenant_names)) != len(tenant_names):
+        raise ValueError("duplicate tenant names")
+    tenant_weights = None
+    if tenant_names:
+        w = np.array([1.0 / (i + 1) ** tenant_skew
+                      for i in range(len(tenant_names))])
+        tenant_weights = w / w.sum()
+    tenant_hists = {t: LatencyHistogram() for t in tenant_names}
+    tenant_counts = {t: {"requests": 0, "ok": 0, "rejected": 0,
+                         "net_errors": 0}
+                     for t in tenant_names}
+    hc_hists = {"hot": LatencyHistogram(), "cold": LatencyHistogram()}
     stop_at = time.monotonic() + duration_s
 
     def account(endpoint: str, status: int, dt: float, rows: int,
-                degraded: bool = False, tier: dict | None = None):
+                degraded: bool = False, tier: dict | None = None,
+                tenant: str | None = None):
         hist.record(dt)
         ep_hists[endpoint].record(dt)
+        if tenant is not None:
+            tenant_hists[tenant].record(dt)
+            hc_hists["hot" if tenant == tenant_names[0]
+                     else "cold"].record(dt)
         with lock:
+            if tenant is not None:
+                tenant_counts[tenant]["requests"] += 1
+                if status == 200:
+                    tenant_counts[tenant]["ok"] += 1
+                else:
+                    tenant_counts[tenant]["rejected"] += 1
             ep_counts[endpoint]["requests"] += 1
             status_counts[str(status)] = status_counts.get(str(status), 0) + 1
             if status == 200:
@@ -369,13 +412,18 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
                         0.0, scale).astype(np.float32)
         else:
             q = (rng.random((batch, 3)) * scale).astype(np.float32)
+        tenant = None
+        if tenant_names:
+            tenant = tenant_names[int(rng.choice(len(tenant_names),
+                                                 p=tenant_weights))]
         endpoint, client = pick_client()
         t0 = time.perf_counter()
         try:
             status, degraded, retry_after, tier = client.post_batch(
-                q, neighbors, binary, recall=recall)
+                q, neighbors, binary, recall=recall, tenant=tenant)
             account(endpoint, status, time.perf_counter() - t0,
-                    batch if status == 200 else 0, degraded, tier)
+                    batch if status == 200 else 0, degraded, tier,
+                    tenant=tenant)
             if status in (429, 503) and retry_after:
                 # honor the server's backpressure, capped by the
                 # --retry-after-cap knob (an outage must not park workers
@@ -386,6 +434,9 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
                 counts["net_error"] += 1
                 ep_counts[endpoint]["requests"] += 1
                 ep_counts[endpoint]["errors"] += 1
+                if tenant is not None:
+                    tenant_counts[tenant]["requests"] += 1
+                    tenant_counts[tenant]["net_errors"] += 1
         return None
 
     def make_picker(wid: int):
@@ -451,6 +502,12 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
     for w in workers:
         w.join(timeout=duration_s + timeout_s + 30)
     elapsed = time.monotonic() - t_start
+    # open loop: a sparse schedule (fractional offered q/s) can finish
+    # its last slot well before the window closes — rates divide by the
+    # offered window, not the early-exit wall, or a one-request run at
+    # 0.1 q/s reports whatever its single latency happened to be
+    if qps > 0:
+        elapsed = max(elapsed, float(duration_s))
 
     total = sum(counts[c] for c in
                 ("ok", "overload", "deadline", "unavailable", "http_error"))
@@ -482,6 +539,45 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
                 "p95_ms": _pct_ms(rep, "p95"),
                 "p99_ms": _pct_ms(rep, "p99"),
             }
+    tenancy = None
+    if tenant_names:
+        per_tenant = {}
+        for i, t in enumerate(tenant_names):
+            rep = tenant_hists[t].report()
+            c = tenant_counts[t]
+            per_tenant[t] = {
+                **c,
+                "rank": i,
+                "share": (round(c["requests"] / attempted, 4)
+                          if attempted else None),
+                "availability": (round(c["ok"] / c["requests"], 4)
+                                 if c["requests"] else None),
+                "p50_ms": _pct_ms(rep, "p50"),
+                "p95_ms": _pct_ms(rep, "p95"),
+                "p99_ms": _pct_ms(rep, "p99"),
+            }
+
+        def _roll(names, h):
+            req = sum(tenant_counts[t]["requests"] for t in names)
+            ok = sum(tenant_counts[t]["ok"] for t in names)
+            rep = h.report()
+            return {"tenants": list(names), "requests": req, "ok": ok,
+                    "availability": round(ok / req, 4) if req else None,
+                    "p50_ms": _pct_ms(rep, "p50"),
+                    "p99_ms": _pct_ms(rep, "p99")}
+
+        # hot = the zipf rank-0 tenant, cold = everything else pooled:
+        # the tenancy bench's primary read for "does a cold tenant still
+        # answer inside its p99 bound under one shared byte budget"
+        tenancy = {
+            "tenants": len(tenant_names),
+            "zipf_a": tenant_skew,
+            "per_tenant": per_tenant,
+            "hot_cold": {
+                "hot": _roll(tenant_names[:1], hc_hists["hot"]),
+                "cold": _roll(tenant_names[1:], hc_hists["cold"]),
+            },
+        }
     return {
         **({"server": ({u: _server_pipeline_stats(u, timeout_s)
                         for u in endpoints} if hosts
@@ -528,6 +624,9 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
             "recall_estimated_counts": dict(
                 sorted(recall_est_counts.items())),
         }} if recall is not None else {}),
+        # multi-index surface (only when --tenants was asked): per-tenant
+        # availability/latency split + the hot/cold rollup
+        **({"tenancy": tenancy} if tenancy is not None else {}),
         "latency_seconds": lat,
         # None (JSON null) when nothing was measured — e.g. server down,
         # every request a net_error — keeping the report strict JSON
@@ -580,6 +679,20 @@ def main(argv=None) -> int:
                          "carries the plan + recall_estimated "
                          "distributions (docs/SERVING.md 'Recall-SLO "
                          "tier')")
+    ap.add_argument("--tenants", type=int, default=0,
+                    help=">0: multi-index mode — spread requests over N "
+                         "tenant namespaces /v1/<t>/knn of one tenanted "
+                         "server (names t0..t{N-1} unless --tenant-names); "
+                         "the report gains per-tenant and hot/cold "
+                         "availability/p50/p99 splits")
+    ap.add_argument("--tenant-names", default=None,
+                    help="comma-separated tenant names (overrides the "
+                         "t0..tN default; list order = zipf rank order, "
+                         "first name is the hot tenant)")
+    ap.add_argument("--tenant-skew", default="zipf:0",
+                    help="traffic skew across tenants as 'zipf:a': rank-i "
+                         "tenant draws weight 1/(i+1)^a (zipf:0 uniform; "
+                         "zipf:1.6 one hot tenant and a cold tail)")
     ap.add_argument("--retry-after-cap", type=float, default=1.0,
                     help="max seconds a closed-loop worker honors a "
                          "Retry-After on 503/429 (default 1.0; raise for "
@@ -590,6 +703,18 @@ def main(argv=None) -> int:
     a = ap.parse_args(argv)
 
     hosts = ([h for h in a.hosts.split(",") if h] if a.hosts else None)
+    if a.tenant_names:
+        tenant_names = [t for t in a.tenant_names.split(",") if t]
+    elif a.tenants > 0:
+        tenant_names = [f"t{i}" for i in range(a.tenants)]
+    else:
+        tenant_names = None
+    if not a.tenant_skew.startswith("zipf:"):
+        ap.error("--tenant-skew must look like 'zipf:a' (e.g. zipf:1.6)")
+    try:
+        tenant_skew = float(a.tenant_skew.partition(":")[2])
+    except ValueError:
+        ap.error("--tenant-skew must look like 'zipf:a' (e.g. zipf:1.6)")
     report = run_load(a.url, duration_s=a.duration, concurrency=a.concurrency,
                       batch=a.batch, qps=a.qps, neighbors=a.neighbors,
                       timeout_s=a.timeout, seed=a.seed, scale=a.scale,
@@ -598,7 +723,8 @@ def main(argv=None) -> int:
                       blob_sigma=a.blob_sigma,
                       sweep_period_s=a.sweep_period, hosts=hosts,
                       retry_after_cap_s=a.retry_after_cap,
-                      recall=a.recall)
+                      recall=a.recall, tenants=tenant_names,
+                      tenant_skew=tenant_skew)
     text = json.dumps(report, indent=2)
     print(text)
     if a.out:

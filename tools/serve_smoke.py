@@ -27,7 +27,13 @@ clustered index, gated on measured recall >= the requested target per
 workload, approx-tier q/s >= 3x exact on clustered (engine tier), the
 no-recall default path staying BITWISE identical through the live
 server, and the exact:false / X-Knn-* / stats / metrics response
-contract (``recall_compare``; tools/ci_tier1.sh passes all flags).
+contract (``recall_compare``), plus (``--tenancy-bench``) the
+multi-index tenancy drill — N zipf-skewed tenants behind ONE shared
+device byte budget vs N isolated single-tenant servers at equal total
+memory, gated on an aggregate q/s floor, per-tenant bitwise probe
+parity vs the isolated twins, a flat warmup compile count, and a
+cold-tenant p99 ceiling (``tenancy_compare``; tools/ci_tier1.sh passes
+all flags).
 
 Boots the full serving stack in-process on a CPU fixture (default: one
 virtual device, single-threaded Eigen, tiled engine — one core per
@@ -124,7 +130,8 @@ def _pod_env() -> dict:
 
 def _run_loadgen(base_url, *, duration_s, concurrency, batch, seed,
                  workload="uniform", blobs=8, blob_sigma=0.02,
-                 sweep_period=None, recall=None) -> dict:
+                 sweep_period=None, recall=None, tenants=None,
+                 tenant_skew=None, qps=None) -> dict:
     """Drive tools/loadgen.py as a SUBPROCESS: the client's request work
     must not share this interpreter's GIL with the server's handler,
     batcher, and merge threads, or the measurement throttles the thing it
@@ -145,6 +152,10 @@ def _run_loadgen(base_url, *, duration_s, concurrency, batch, seed,
             + (["--sweep-period", str(sweep_period)]
                if sweep_period else [])
             + (["--recall", str(recall)] if recall is not None else [])
+            + (["--tenant-names", ",".join(tenants),
+                "--tenant-skew", f"zipf:{tenant_skew or 0:g}"]
+               if tenants else [])
+            + (["--qps", str(qps)] if qps else [])
             + ["--out", out_path],
             check=True, stdout=subprocess.DEVNULL, timeout=duration_s + 120)
         with open(out_path) as f:
@@ -540,20 +551,289 @@ def run_streaming_bench(*, n_points=16384, k=16, num_slabs=8,
     }
 
 
-def _post_probe(base_url, q):
+def _post_probe(base_url, q, path="/knn"):
     """POST a probe batch (JSON, neighbors on) -> (dists f32[n],
     neighbors i32[n, k]). f32 distances survive the JSON float64
     round-trip exactly (every f32 is representable), so the comparison
-    upstream is genuinely bitwise."""
+    upstream is genuinely bitwise. ``path`` selects a tenant namespace
+    (``/v1/<tenant>/knn``) on a multi-index server."""
     body = json.dumps({"queries": np.asarray(q).tolist(),
                        "neighbors": True}).encode()
     req = urllib.request.Request(
-        base_url + "/knn", data=body,
+        base_url + path, data=body,
         headers={"Content-Type": "application/json"})
     with urllib.request.urlopen(req, timeout=120) as resp:
         obj = json.loads(resp.read())
     return (np.asarray(obj["dists"], np.float32),
             np.asarray(obj["neighbors"], np.int32))
+
+
+def run_tenancy_bench(*, tenants=6, points_per_tenant=8192, k=8,
+                      num_slabs=6, budget_slabs_total=12, duration_s=6.0,
+                      concurrency=20, batch=16, max_batch=16,
+                      max_delay_s=0.008, trials=3, seed=0,
+                      qps_ratio_floor=1.3, skew_a=4.0, offered_qps=8.0,
+                      promote_delay_s=0.4, blobs=8, blob_sigma=0.02,
+                      cold_p99_ceiling_ms=6000.0) -> dict:
+    """Multi-index tenancy (serve/tenancy.py): N tenants' indexes behind
+    ONE shared device byte budget vs N isolated single-tenant servers at
+    EQUAL TOTAL memory (each gets budget_slabs_total/N slabs), both
+    driven by the same zipf-skewed clustered traffic (rank-i tenant
+    draws 1/(i+1)^skew_a of the requests — one hot tenant, a cold tail;
+    each request samples one Gaussian blob, so its certified slab set
+    is a fraction of the index, the locality tiered serving exists
+    for).
+
+    The economics being measured: every tenant's index is the same
+    size (``num_slabs`` slabs — default 6 tenants x 6 slabs = 36), and
+    the shared budget (default 12 slabs) is split evenly for the
+    isolated twins (2 each) — the static partition an operator without
+    traffic knowledge would pick, and exactly equal in total bytes.
+    The shared pool's LRU turns the zipf skew into residency: the hot
+    tenant's whole index ends up device-resident (its slabs are
+    re-touched too often to be eviction victims) while the six
+    leftover slots absorb the cold tail's promotions — a cold
+    request's whole working set fits the spare slots, so cold churn
+    never evicts the hot index. The isolated hot twin is pinned at
+    budget 2 against a 6-slab working set, so nearly every request
+    waits out promotions — and it is carrying ~93% of the offered
+    load. Memory the static split parked on idle tenants is memory
+    the skew cannot use.
+    On this CPU fixture a "device upload" is a host-memory memcpy, so
+    residency would be free and the comparison would measure nothing:
+    every promotion carries a deterministic injected latency of
+    ``promote_delay_s`` (serve/faults.py, ``PROMOTE /slab/...``) on
+    BOTH the shared pool and every isolated twin. The delay is scaled
+    to the fixture, not to a wall clock: it keeps promotion cost at a
+    few tens of dispatch-computes, the regime of a multi-GB slab over
+    PCIe against a sub-millisecond kernel — the ratio the real
+    system's streaming economics live in. (Prefetch is off on both
+    sides: with promotions this expensive, speculative whole-plan
+    prefetch through the pool's single async lane is pure poison —
+    it would serialize behind itself and evict live slabs for
+    speculative ones.) The comparison therefore measures
+    promotion-count economics: how much less the shared pool uploads
+    under skew, priced at a fixed cost per upload.
+
+    Both sides run OPEN LOOP at the same offered load
+    (``offered_qps`` total, split across the isolated servers by the
+    same zipf weights the shared server's request stream draws from):
+    a closed loop would let the idle cold twins free-run at saturation
+    — traffic the skewed demand never offers them — and count it as
+    isolated throughput; worse, under zipf picks a closed loop
+    converts the cold tail's request share into worker-TIME share,
+    drowning the hot tenant. Open loop offers each side the identical
+    demand shape through a worker pool deep enough that multi-second
+    promotion stalls never starve the attempt stream, and measures
+    GOODPUT — answered 200s per second of the offered window (fast
+    429/503 shedding does not count, and neither does a sparse
+    schedule's early exit): the isolated hot twin saturates well below
+    its offered slice because nearly every request waits out
+    promotions, while the shared server keeps the hot tenant resident
+    and absorbs the same demand.
+
+    Four gates ride the exit code (``tenancy_compare`` in
+    BENCH_serve.json): (1) shared achieved q/s >= ``qps_ratio_floor`` x
+    the isolated servers' total at equal memory, equal client
+    concurrency, and equal offered load; (2) every tenant's probe
+    answers through
+    ``/v1/<tenant>/knn`` are BITWISE identical (dists AND ids) to its
+    isolated single-tenant twin's, before AND after the load churn —
+    tenancy shares capacity, never results; (3) the shared server's
+    warmup compile count stays FLAT vs one single-tenant engine (all
+    tenants pad to the pool's shape classes, so the ExecutableCache
+    hits across tenants); (4) the coldest tenant's p99 through the
+    shared server stays under ``cold_p99_ceiling_ms`` — eviction
+    fairness: a cold tenant is slower (stall-counted), never starved."""
+    _setup_cpu_fixture(1)
+    from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+    from mpi_cuda_largescaleknn_tpu.serve.faults import (FaultInjector,
+                                                         FaultSpec)
+    from mpi_cuda_largescaleknn_tpu.serve.server import build_server
+    from mpi_cuda_largescaleknn_tpu.serve.slabpool import StreamingKnnEngine
+    from mpi_cuda_largescaleknn_tpu.serve.tenancy import (MultiTenantEngine,
+                                                          TenantSpec)
+    from mpi_cuda_largescaleknn_tpu.utils.math import morton_argsort
+
+    def _goodput(rep):
+        """Achieved 200s/s. The loadgen's own ``qps`` counts every
+        COMPLETED request — a server shedding load with fast 429/503s
+        would inflate it toward the offered rate; answered queries are
+        the thing the two deployments are being compared on."""
+        return round(rep["ok"] / max(float(rep["duration_s"]), 1e-9), 2)
+
+    def dma_model():
+        """One injector per pool (separate firing state), same fixed
+        cost: every promotion sleeps ``promote_delay_s``."""
+        return FaultInjector([FaultSpec(
+            "latency", path="/slab/", method="PROMOTE",
+            delay_s=promote_delay_s)])
+
+    names = [f"t{i}" for i in range(tenants)]
+
+    def mk_points(i):
+        rng = np.random.default_rng((seed, i))
+        p = rng.random((points_per_tenant, 3)).astype(np.float32)
+        return p[morton_argsort(p, p.min(axis=0), p.max(axis=0))]
+
+    points = {n: mk_points(i) for i, n in enumerate(names)}
+    mesh = get_mesh(1)
+    kw = dict(engine="tiled", bucket_size=64, max_batch=max_batch,
+              min_batch=16)
+    # zipf weights mirror loadgen's pick distribution; they also split
+    # the isolated servers' client concurrency so both sides see the
+    # same offered-load shape at the same total worker count
+    w = np.array([1.0 / (i + 1) ** skew_a for i in range(tenants)])
+    w = w / w.sum()
+    iso_conc = [max(1, int(round(concurrency * wi))) for wi in w]
+
+    shared = MultiTenantEngine(
+        [TenantSpec(n, points=points[n], num_slabs=num_slabs)
+         for n in names],
+        k=k, mesh=mesh, prefetch_depth=0, faults=dma_model(), **kw)
+    budget = shared.slab_device_bytes * budget_slabs_total
+    shared.slab_pool.set_device_budget(budget)
+    warm = shared.warmup()
+    shared_compiles = int(warm["compile_count"])
+    srv = build_server(shared, port=0, max_delay_s=max_delay_s,
+                       pipeline_depth=3)
+    srv.ready = True
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+
+    # isolated twins: same engine class, same knobs, each with its OWN
+    # exec cache (a real isolated deployment compiles for itself) and a
+    # 1/N slice of the device budget
+    iso_budget = shared.slab_device_bytes * max(
+        1, budget_slabs_total // tenants)
+    iso = {}
+    try:
+        for n in names:
+            e = StreamingKnnEngine(points=points[n], num_slabs=num_slabs,
+                                   k=k, mesh=mesh, prefetch_depth=0,
+                                   faults=dma_model(), **kw)
+            e.slab_pool.set_device_budget(iso_budget)
+            e.warmup()
+            s = build_server(e, port=0, max_delay_s=max_delay_s,
+                             pipeline_depth=3)
+            s.ready = True
+            threading.Thread(target=s.serve_forever, daemon=True).start()
+            iso[n] = (e, s, f"http://127.0.0.1:{s.server_address[1]}")
+        single_compiles = int(iso[names[0]][0].stats()["compile_count"])
+
+        # probe fits max_batch (the frontend 413s bigger bodies); 16
+        # uniform queries still walk essentially every slab of a
+        # 6-slab index, which is what parity and the hot re-warm need
+        probe = np.random.default_rng(seed + 7).random((16, 3)).astype(
+            np.float32)
+
+        def parity():
+            ok = {}
+            for n in names:
+                got = _post_probe(base, probe, path=f"/v1/{n}/knn")
+                want = _post_probe(iso[n][2], probe)
+                ok[n] = bool(np.array_equal(got[0], want[0])
+                             and np.array_equal(got[1], want[1]))
+            return ok
+
+        parity_cold = parity()
+
+        # shared phase first, isolated second — the phases must not
+        # contend for the box's cores with each other. Each trial opens
+        # with a hot-tenant probe: the parity sweep (and the previous
+        # trial's cold churn) leaves OTHER tenants' slabs resident, and
+        # the trial measures the steady state the skewed traffic itself
+        # maintains, not the transient of rebuilding it (the isolated
+        # hot twin needs no equivalent warm — a probe's residency IS
+        # its steady state, a 3-slab LRU slice of a 6-slab working set)
+        shared_reps = []
+        for t in range(trials):
+            _post_probe(base, probe, path=f"/v1/{names[0]}/knn")
+            shared_reps.append(_run_loadgen(
+                base, duration_s=duration_s, concurrency=concurrency,
+                batch=batch, seed=seed + t, workload="clustered",
+                blobs=blobs, blob_sigma=blob_sigma, tenants=names,
+                tenant_skew=skew_a, qps=offered_qps))
+        iso_totals = []
+        for t in range(trials):
+            out = [None] * tenants
+
+            def one(i, n, t=t):
+                out[i] = _run_loadgen(
+                    iso[n][2], duration_s=duration_s,
+                    concurrency=iso_conc[i], batch=batch,
+                    seed=seed + 100 + t * tenants + i,
+                    workload="clustered", blobs=blobs,
+                    blob_sigma=blob_sigma,
+                    qps=round(offered_qps * w[i], 3))
+
+            ths = [threading.Thread(target=one, args=(i, n))
+                   for i, n in enumerate(names)]
+            for th in ths:
+                th.start()
+            for th in ths:
+                th.join()
+            iso_totals.append({
+                "qps_total": round(sum(_goodput(r) for r in out if r), 2),
+                "per_tenant_qps": {n: (_goodput(out[i]) if out[i]
+                                       else None)
+                                   for i, n in enumerate(names)}})
+
+        # ... and parity again after the load has churned both pools
+        parity_hot = parity()
+        pool_stats = shared.slab_pool.stats()
+    finally:
+        srv.close()
+        for n in iso:
+            iso[n][1].close()
+            iso[n][0].close()
+        shared.close()
+
+    med_shared = sorted(shared_reps, key=_goodput)[len(shared_reps) // 2]
+    med_iso = sorted(iso_totals, key=lambda r: r["qps_total"])[
+        len(iso_totals) // 2]
+    qps_ratio = (round(_goodput(med_shared) / med_iso["qps_total"], 3)
+                 if med_iso["qps_total"] else None)
+    tenancy_rep = med_shared.get("tenancy", {})
+    cold_roll = tenancy_rep.get("hot_cold", {}).get("cold", {})
+    cold_p99 = cold_roll.get("p99_ms")
+    parity_all = all(parity_cold.values()) and all(parity_hot.values())
+    return {
+        "kind": "serve_tenancy_bench", "tenants": tenants,
+        "points_per_tenant": points_per_tenant, "k": k,
+        "num_slabs_per_tenant": num_slabs,
+        "budget_slabs_total": budget_slabs_total,
+        "device_budget_bytes": budget,
+        "iso_device_budget_bytes_each": iso_budget,
+        "zipf_a": skew_a, "duration_s": duration_s,
+        "concurrency": concurrency, "iso_concurrency": iso_conc,
+        "batch": batch, "trials": trials, "workload": "clustered",
+        "blobs": blobs, "blob_sigma": blob_sigma,
+        "offered_qps": offered_qps,
+        "offered_qps_per_tenant": [round(offered_qps * wi, 3) for wi in w],
+        "promote_delay_model_s": promote_delay_s,
+        "qps_shared": _goodput(med_shared),
+        "qps_shared_trials": [_goodput(r) for r in shared_reps],
+        "qps_isolated_total": med_iso["qps_total"],
+        "qps_isolated_trials": [t["qps_total"] for t in iso_totals],
+        "qps_isolated_per_tenant": med_iso["per_tenant_qps"],
+        "qps_ratio": qps_ratio, "qps_ratio_floor": qps_ratio_floor,
+        "qps_ratio_ok": bool(qps_ratio is not None
+                             and qps_ratio >= qps_ratio_floor),
+        "per_tenant": tenancy_rep.get("per_tenant"),
+        "hot_cold": tenancy_rep.get("hot_cold"),
+        "cold_tenant": names[-1], "cold_p99_ms": cold_p99,
+        "cold_p99_ceiling_ms": cold_p99_ceiling_ms,
+        "cold_p99_ok": bool(cold_p99 is not None
+                            and cold_p99 <= cold_p99_ceiling_ms),
+        "compile_count_shared": shared_compiles,
+        "compile_count_single_tenant": single_compiles,
+        "compile_flat": bool(shared_compiles <= single_compiles),
+        "bitwise_parity_cold": parity_cold,
+        "bitwise_parity_hot": parity_hot,
+        "parity_all": bool(parity_all),
+        "pool_tenants": pool_stats.get("tenants"),
+    }
 
 
 def run_recall_bench(*, n_points=131072, k=16, bucket_size=64,
@@ -1852,6 +2132,17 @@ def main(argv=None) -> int:
                     help="internal: run ONLY the wire bench in this "
                          "process (1-device fixture, boots its own "
                          "in-process pods) and print its JSON")
+    ap.add_argument("--tenancy-bench", action="store_true",
+                    help="also run the multi-index tenancy bench (N "
+                         "zipf-skewed tenants behind one shared device "
+                         "byte budget vs N isolated servers at equal "
+                         "total memory: aggregate q/s floor, per-tenant "
+                         "bitwise parity, flat compile count, cold-tenant "
+                         "p99 ceiling) in a subprocess and embed "
+                         "tenancy_compare")
+    ap.add_argument("--tenancy-child", action="store_true",
+                    help="internal: run ONLY the tenancy bench in this "
+                         "process (1-device fixture) and print its JSON")
     ap.add_argument("--kernel-bench", action="store_true",
                     help="also run the distance-kernel bench (elementwise "
                          "VPU vs MXU matmul-form at D in {3, 8, 64}) in a "
@@ -1898,6 +2189,24 @@ def main(argv=None) -> int:
         report = run_kernel_bench(n_points=a.points, k=a.k, seed=a.seed)
         print(json.dumps(report, indent=2))
         return 0 if report.get("exact_bitwise") else 1
+
+    if a.tenancy_child:
+        # the tenancy bench pins its OWN fixture shape (3 tenants x 8k
+        # points x 6 slabs, 12-slab shared budget vs 4 slabs per
+        # isolated twin — see run_tenancy_bench: the shared-pool win
+        # lives in the skewed-traffic memory economics, which need the
+        # isolated hot twin genuinely over-budget) AND its own client
+        # shape (open loop at a fixed offered rate, a worker pool deep
+        # enough that multi-second promotion stalls never starve the
+        # attempt stream); only duration/trials/seed ride through
+        report = run_tenancy_bench(
+            duration_s=max(4.0, a.duration), trials=max(2, a.trials),
+            seed=a.seed)
+        print(json.dumps(report, indent=2))
+        return 0 if (report.get("parity_all")
+                     and report.get("qps_ratio_ok")
+                     and report.get("compile_flat")
+                     and report.get("cold_p99_ok")) else 1
 
     if a.wire_child:
         # the wire bench pins its OWN fixture shapes (16k-point 2-slab
@@ -2102,6 +2411,42 @@ def main(argv=None) -> int:
                 detail = (raw.decode(errors="replace")
                           if isinstance(raw, bytes) else str(raw))[-1500:]
             report["streaming_compare"] = {
+                "error": f"{str(e)[:300]} :: {detail}"}
+    if a.tenancy_bench:
+        # same subprocess discipline: the tenancy child pins the
+        # 1-device single-thread fixture and boots its own shared +
+        # isolated servers. ALL FOUR tenancy gates ride the exit code
+        # (the multi-index issue's acceptance bar): shared aggregate
+        # q/s >= the floor multiple of the equal-memory isolated total,
+        # per-tenant bitwise probe parity vs the single-tenant twins
+        # (cold AND post-churn), warmup compile count flat vs one
+        # tenant, and the cold tenant's p99 under its ceiling
+        try:
+            child = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--tenancy-child",
+                 "--duration", str(a.duration),
+                 "--concurrency", str(a.concurrency),
+                 "--batch", str(a.batch), "--trials", str(a.trials),
+                 "--max-delay-ms", str(a.max_delay_ms),
+                 "--seed", str(a.seed)],
+                capture_output=True, text=True, env=env,
+                timeout=600 + a.duration * (a.trials + 2) * 8)
+            tc = json.loads(child.stdout)
+            report["tenancy_compare"] = tc
+            if "error" not in tc:  # infra hiccups degrade, never gate
+                ok = (ok and bool(tc.get("parity_all"))
+                      and bool(tc.get("qps_ratio_ok"))
+                      and bool(tc.get("compile_flat"))
+                      and bool(tc.get("cold_p99_ok")))
+        except (subprocess.TimeoutExpired, json.JSONDecodeError) as e:
+            if isinstance(e, json.JSONDecodeError):
+                detail = (child.stderr or child.stdout or "")[-1500:]
+            else:
+                raw = e.stderr or e.stdout or b""
+                detail = (raw.decode(errors="replace")
+                          if isinstance(raw, bytes) else str(raw))[-1500:]
+            report["tenancy_compare"] = {
                 "error": f"{str(e)[:300]} :: {detail}"}
     if a.recall_bench:
         # same subprocess discipline: the recall child pins the 1-device
