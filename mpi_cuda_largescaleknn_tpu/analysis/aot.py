@@ -100,9 +100,13 @@ def trace_contract() -> dict:
             fn = engine._build_query_fn(engine.engine_name, qpad, qb)
             args = engine._resident_args(engine.engine_name)
             q0 = jax.ShapeDtypeStruct((qpad, engine.dim), np.float32)
-            out = jax.eval_shape(fn, *args, q0)
+            # the per-query init-radius operand (certified radius
+            # seeding, serve/qcache.py) — part of every program's arity
+            r0 = jax.ShapeDtypeStruct((qpad,), np.float32)
+            out = jax.eval_shape(fn, *args, q0, r0)
             programs[f"q{qpad}|B{qb}"] = {
-                "in": [_aval_str(a) for a in args] + [_aval_str(q0)],
+                "in": [_aval_str(a) for a in args]
+                      + [_aval_str(q0), _aval_str(r0)],
                 "out": [_aval_str(o) for o in out],
             }
         out_configs.append({

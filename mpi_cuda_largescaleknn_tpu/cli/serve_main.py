@@ -99,6 +99,19 @@ SERVE_FLAGS = """
                     previous batch's host merge; 1 = fully serialized)
   --max-queue-rows N  admission cap on queued+running rows (default 4096)
   --timeout-ms F    default per-request deadline (default 5000)
+  --qcache-rows N   certified query cache capacity in cached rows
+                    (default 4096; 0 disables the cache —
+                    serve/qcache.py): byte-identical exact-hit reuse
+                    keyed by (tenant, index generation, plan, query
+                    bytes), plus in-flight dedup of concurrent
+                    duplicates (docs/SERVING.md "Query cache & radius
+                    seeding")
+  --qcache-seed-rows N  triangle-inequality seed pool rows per tenant
+                    (default 512; 0 disables radius seeding while
+                    keeping the hit/dedup tiers): near-duplicates of a
+                    cached query start their top-k heap at a certified
+                    radius r = d_k(q0) + ||q - q0|| — provably
+                    bit-identical answers, earlier tile pruning
   --recall-policy PATH  recall-SLO plan table (JSON from
                     tools/recall_harness.py) replacing the built-in
                     calibrated defaults; requests carrying
@@ -180,6 +193,7 @@ def parse_serve_args(argv: list[str]) -> dict:
            "prefetch_depth": 1,
            "max_delay_ms": 2.0, "pipeline_depth": 2,
            "max_queue_rows": 4096, "seq_timeout_s": None,
+           "qcache_rows": 4096, "qcache_seed_rows": 512,
            "recall_policy": None,
            "timeout_ms": 5000.0, "warmup": True, "timings": False,
            "verbose": False,
@@ -243,6 +257,10 @@ def parse_serve_args(argv: list[str]) -> dict:
                 i += 1; opt["timeout_ms"] = float(argv[i])
             elif arg == "--seq-timeout-s":
                 i += 1; opt["seq_timeout_s"] = float(argv[i])
+            elif arg == "--qcache-rows":
+                i += 1; opt["qcache_rows"] = int(argv[i])
+            elif arg == "--qcache-seed-rows":
+                i += 1; opt["qcache_seed_rows"] = int(argv[i])
             elif arg == "--recall-policy":
                 i += 1; opt["recall_policy"] = argv[i]
             elif arg == "--coordinator":
@@ -402,7 +420,9 @@ def main(argv: list[str] | None = None) -> int:
             max_queue_rows=opt["max_queue_rows"],
             default_timeout_s=opt["timeout_ms"] / 1e3,
             verbose=opt["verbose"], recall_policy=recall_policy,
-            tenant_quota_rows=opt["tenant_quota_rows"])
+            tenant_quota_rows=opt["tenant_quota_rows"],
+            qcache_rows=opt["qcache_rows"],
+            qcache_seed_rows=opt["qcache_seed_rows"])
         try:
             serve_forever(server, warmup=opt["warmup"])
         except KeyboardInterrupt:
@@ -561,7 +581,9 @@ def main(argv: list[str] | None = None) -> int:
         max_queue_rows=opt["max_queue_rows"],
         default_timeout_s=opt["timeout_ms"] / 1e3,
         verbose=opt["verbose"],
-        recall_policy=recall_policy)
+        recall_policy=recall_policy,
+        qcache_rows=opt["qcache_rows"],
+        qcache_seed_rows=opt["qcache_seed_rows"])
     try:
         serve_forever(server, warmup=opt["warmup"])
     except KeyboardInterrupt:

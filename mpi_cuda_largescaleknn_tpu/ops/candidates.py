@@ -35,11 +35,26 @@ import jax.numpy as jnp
 from mpi_cuda_largescaleknn_tpu.core.types import CandidateState
 
 
-def init_candidates(num_queries: int, k: int, max_radius: float = jnp.inf) -> CandidateState:
+def init_candidates(num_queries: int, k: int, max_radius=jnp.inf) -> CandidateState:
     """Fresh candidate state bounded by ``max_radius`` (f32 semantics:
-    slots hold ``float32(max_radius)**2``)."""
-    r = jnp.float32(max_radius)
-    dist2 = jnp.full((num_queries, k), r * r, dtype=jnp.float32)
+    slots hold ``float32(max_radius)**2``).
+
+    ``max_radius`` may also be a per-query ``f32[num_queries]`` array:
+    row q's slots then hold ``max_radius[q]**2`` — the serving engine's
+    certified radius seeding (serve/qcache.py), where a cached answer's
+    triangle-inequality bound tightens ONE row's prune. As an array it
+    is a runtime operand, not a trace-time constant, so every radius
+    vector shares one compiled program (the AOT bucket keys stay flat).
+    The strict-< adoption semantics are per-row unchanged: a candidate
+    at or beyond that row's radius is never recorded."""
+    r = jnp.asarray(max_radius, jnp.float32)
+    if r.ndim == 0:
+        dist2 = jnp.full((num_queries, k), r * r, dtype=jnp.float32)
+    else:
+        if r.shape != (num_queries,):
+            raise ValueError(f"per-query max_radius must be "
+                             f"[{num_queries}], got {r.shape}")
+        dist2 = jnp.broadcast_to((r * r)[:, None], (num_queries, k))
     idx = jnp.full((num_queries, k), -1, dtype=jnp.int32)
     return CandidateState(dist2, idx)
 

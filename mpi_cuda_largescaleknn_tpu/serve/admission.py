@@ -156,32 +156,40 @@ class GracefulQueryFn:
                     == self.engine.engine_name):
                 raise e
 
-    def _query(self, queries, plan, tenant=None):
+    def _query(self, queries, plan, tenant=None, seed_radius=None):
         # exact single-index requests use the legacy single-arg form so
         # engines (and test doubles) without a plan/tenant kwarg keep
         # working — the batcher's compatibility rule, applied to the
-        # degradation shim too
+        # degradation shim too. Certified radius seeds (serve/qcache.py)
+        # ride the same conditional-kwarg rule: only engines actually
+        # handed seeds need to understand ``seed_radius``.
+        kw = {} if seed_radius is None else {"seed_radius": seed_radius}
         if tenant is not None:
-            return self.engine.query(queries, plan=plan, tenant=tenant)
-        return (self.engine.query(queries) if plan is None
-                else self.engine.query(queries, plan=plan))
+            return self.engine.query(queries, plan=plan, tenant=tenant, **kw)
+        return (self.engine.query(queries, **kw) if plan is None
+                else self.engine.query(queries, plan=plan, **kw))
 
-    def __call__(self, queries, plan=None, tenant=None):
+    def __call__(self, queries, plan=None, tenant=None, seed_radius=None):
         try:
-            return self._query(queries, plan, tenant)
+            return self._query(queries, plan, tenant, seed_radius)
         except Exception as e:  # noqa: BLE001 - re-raised unless degradable
             self._degrade_or_raise(e)
+            # the degraded replay runs UNSEEDED: seeds never change the
+            # answer, so dropping them is sound — and it keeps the replay
+            # maximally conservative while the engine is already hurt
             return self._query(queries, plan, tenant)
 
-    def _dispatch(self, queries, plan, tenant=None):
+    def _dispatch(self, queries, plan, tenant=None, seed_radius=None):
+        kw = {} if seed_radius is None else {"seed_radius": seed_radius}
         if tenant is not None:
-            return self.engine.dispatch(queries, plan=plan, tenant=tenant)
-        return (self.engine.dispatch(queries) if plan is None
-                else self.engine.dispatch(queries, plan=plan))
+            return self.engine.dispatch(queries, plan=plan, tenant=tenant,
+                                        **kw)
+        return (self.engine.dispatch(queries, **kw) if plan is None
+                else self.engine.dispatch(queries, plan=plan, **kw))
 
-    def dispatch(self, queries, plan=None, tenant=None):
+    def dispatch(self, queries, plan=None, tenant=None, seed_radius=None):
         try:
-            return self._dispatch(queries, plan, tenant)
+            return self._dispatch(queries, plan, tenant, seed_radius)
         except Exception as e:  # noqa: BLE001 - re-raised unless degradable
             self._degrade_or_raise(e)
             return self._dispatch(queries, plan, tenant)

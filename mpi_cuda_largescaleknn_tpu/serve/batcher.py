@@ -71,6 +71,9 @@ class _Request:
     #: tenant namespace (serve/tenancy.py; None = single-index serving).
     #: Joins the coalescing key — one engine batch never mixes indexes.
     tenant: str | None = None
+    #: certified per-row init radii (serve/qcache.py seed_for; None =
+    #: unseeded). f32[rows]; +inf rows are unseeded. Exact-tier only.
+    seeds: np.ndarray | None = None
     done: threading.Event = field(default_factory=threading.Event)
     result: tuple | None = None
     error: Exception | None = None
@@ -105,12 +108,18 @@ class DynamicBatcher:
     def __init__(self, query_fn, *, max_batch: int,
                  max_delay_s: float = 0.002, timers=None,
                  pipeline_depth: int = 1, min_batch: int | None = None,
-                 dim: int | None = None):
+                 dim: int | None = None, qcache=None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         if pipeline_depth < 1:
             raise ValueError("pipeline_depth must be >= 1")
         self._query_fn = query_fn
+        #: serve/qcache.py QueryCache (None = reuse layer off): submit()
+        #: resolves every row through it — exact hits are served from the
+        #: LRU with zero device work, duplicate rows join the in-flight
+        #: owner, and exact-tier misses dispatch with certified radius
+        #: seeds. When set, query_fn must accept ``seed_radius=``.
+        self.qcache = qcache
         #: point dimensionality for normalizing flat submit() inputs;
         #: taken from the query_fn's engine/fanout when not given (3 as
         #: the last-resort legacy default)
@@ -200,14 +209,78 @@ class DynamicBatcher:
         traffic splits into per-plan sub-batches instead of forcing the
         strictest plan on everyone. ``tenant`` (serve/tenancy.py, None =
         single-index) does the same per index: a flush never mixes two
-        tenants' rows in one engine batch."""
+        tenants' rows in one engine batch.
+
+        With a query cache attached (``qcache``), every row first resolves
+        through the reuse tiers: an exact HIT is answered from the LRU
+        (byte-identical, zero device work), a duplicate of an in-flight
+        row JOINs its owner's entry, and the remaining rows dispatch as
+        this request's own sub-batch — seeded with certified init radii
+        on the exact tier. An all-hit request never touches the queue."""
         # normalize to [n, dim] rows (flat inputs carry n*dim floats — the
         # legacy direct-caller contract, now D-generic via self.dim)
         queries = np.asarray(queries, np.float32).reshape(-1, self.dim)
+        qc = self.qcache
+        if qc is None or len(queries) == 0:
+            return self._submit_rows(queries, timeout_s, plan, tenant, None)
+        n = len(queries)
+        plan_token = None if plan is None else plan.batch_key()
+        actions = qc.begin(queries, plan_token, tenant)
+        own_idx = [i for i, a in enumerate(actions) if a[0] == "own"]
+        owned_keys = [actions[i][1] for i in own_idx]
+        rows: list = [None] * n
+        try:
+            if own_idx:
+                sub_q = queries[own_idx] if len(own_idx) < n else queries
+                seeds = qc.seed_for(sub_q, tenant) if plan is None else None
+                outs = self._submit_rows(sub_q, timeout_s, plan, tenant,
+                                         seeds)
+                # publish BEFORE waiting on other owners' entries: owners
+                # that publish before they park can never deadlock
+                qc.publish(owned_keys, outs, sub_q, plan_token, tenant)
+                if len(own_idx) == n:
+                    return outs  # pure miss: no reassembly needed
+                for j, i in enumerate(own_idx):
+                    rows[i] = tuple(a[j] for a in outs)
+        except Exception as e:  # noqa: BLE001 - joiners must not hang
+            qc.abort(owned_keys, e)
+            raise
+        grace = None if timeout_s is None else timeout_s + 30.0
+        retry = []
+        for i, a in enumerate(actions):
+            if a[0] == "hit":
+                rows[i] = a[1]
+            elif a[0] == "join":
+                if not a[1].event.wait(grace):
+                    raise DeadlineExceeded(
+                        "deduplicated row stuck behind its in-flight owner")
+                if a[1].error is not None:
+                    # owner failed: retry the row as our own sub-batch,
+                    # bypassing the cache (the aborted entries are gone,
+                    # and a re-join could chain onto another failing owner)
+                    retry.append(i)
+                else:
+                    rows[i] = a[1].result
+        if retry:
+            outs = self._submit_rows(queries[retry], timeout_s, plan,
+                                     tenant, None)
+            for j, i in enumerate(retry):
+                rows[i] = tuple(a[j] for a in outs)
+        for i, a in enumerate(actions):
+            if a[0] == "local":
+                rows[i] = rows[a[1]]
+        return tuple(np.stack([r[c] for r in rows])
+                     for c in range(len(rows[0])))
+
+    def _submit_rows(self, queries: np.ndarray,
+                     timeout_s: float | None, plan, tenant,
+                     seeds: np.ndarray | None):
+        """Enqueue one device sub-batch and block for its result — the
+        pre-cache submit path, verbatim."""
         now = time.monotonic()
         req = _Request(queries=queries, enqueued=now,
                        deadline=(now + timeout_s) if timeout_s else None,
-                       plan=plan, tenant=tenant)
+                       plan=plan, tenant=tenant, seeds=seeds)
         with self._cond:
             if self._shutdown:
                 raise RuntimeError("batcher is shut down")
@@ -319,6 +392,19 @@ class DynamicBatcher:
             r.error = err
             r.done.set()
 
+    @staticmethod
+    def _merged_seeds(live: list[_Request]) -> np.ndarray | None:
+        """Concatenated per-row init radii for a flush, or None when no
+        request in it carries seeds (the common case — and the ONLY case
+        for legacy/test-double query_fns, which are never handed a
+        ``seed_radius`` kwarg they don't know). Unseeded requests pad
+        with +inf rows — the engine treats +inf as its static radius."""
+        if all(r.seeds is None for r in live):
+            return None
+        parts = [r.seeds if r.seeds is not None
+                 else np.full(r.rows, np.inf, np.float32) for r in live]
+        return parts[0] if len(parts) == 1 else np.concatenate(parts)
+
     # -------------------------------------------------- serialized (depth 1)
 
     def _run(self):
@@ -337,12 +423,17 @@ class DynamicBatcher:
                 # form so plain test doubles (and the pre-tier wire) stay
                 # compatible; tenant/plan kwargs only appear when set
                 plan, tenant = live[0].plan, live[0].tenant
+                kw = {}
+                seeds = self._merged_seeds(live)
+                if seeds is not None:
+                    kw["seed_radius"] = seeds
                 if tenant is not None:
-                    outs = self._query_fn(merged, plan=plan, tenant=tenant)
+                    outs = self._query_fn(merged, plan=plan, tenant=tenant,
+                                          **kw)
                 elif plan is None:
-                    outs = self._query_fn(merged)
+                    outs = self._query_fn(merged, **kw)
                 else:
-                    outs = self._query_fn(merged, plan=plan)
+                    outs = self._query_fn(merged, plan=plan, **kw)
                 if self._timers is not None:
                     self._timers.hist("batch_exec_seconds").record(
                         time.perf_counter() - t0)
@@ -414,13 +505,17 @@ class DynamicBatcher:
             try:
                 t0 = time.perf_counter()
                 plan, tenant = live[0].plan, live[0].tenant
+                kw = {}
+                seeds = self._merged_seeds(live)
+                if seeds is not None:
+                    kw["seed_radius"] = seeds
                 if tenant is not None:
                     handle = self._query_fn.dispatch(merged, plan=plan,
-                                                     tenant=tenant)
+                                                     tenant=tenant, **kw)
                 elif plan is None:
-                    handle = self._query_fn.dispatch(merged)
+                    handle = self._query_fn.dispatch(merged, **kw)
                 else:
-                    handle = self._query_fn.dispatch(merged, plan=plan)
+                    handle = self._query_fn.dispatch(merged, plan=plan, **kw)
             except Exception as e:  # noqa: BLE001 - delivered per request
                 self._fail(live, e)
                 with self._cond:

@@ -519,7 +519,7 @@ class ResidentKnnEngine:
             device_merge_final,
         )
 
-        k, max_radius = self.k, self.max_radius
+        k = self.k
         num_shards = self.num_shards
         device_merge = self.merge_mode == "device"
         emit_candidates = self.emit == "candidates"
@@ -556,9 +556,9 @@ class ResidentKnnEngine:
                 if use_mxu:
                     # the precomputed per-bucket ||p||^2 rides as an extra
                     # resident operand (computed once at upload)
-                    bpts, bids, blo, bhi, bn2, q = args
+                    bpts, bids, blo, bhi, bn2, q, qr = args
                 else:
-                    (bpts, bids, blo, bhi, q), bn2 = args, None
+                    (bpts, bids, blo, bhi, q, qr), bn2 = args, None
                 # q f32[qpad,3] is REPLICATED: every device traverses its own
                 # resident shard for the same queries; its local top-k is
                 # exact over that shard, and the merge of the R partial
@@ -579,7 +579,14 @@ class ResidentKnnEngine:
                 hi = jnp.max(jnp.where(vg, qg, -jnp.inf), axis=1)
                 qb = BucketedPoints(qg, qids.reshape(qbuckets, s_q), lo, hi,
                                     qids.reshape(qbuckets, s_q))
-                heap = pvary(init_candidates(qpad, k, max_radius))
+                # qr f32[qpad] is the PER-QUERY init radius — a runtime
+                # operand, so a seeded batch and an unseeded one run the
+                # SAME compiled program (dispatch fills max_radius rows
+                # for unseeded queries and pads; serve/qcache.py supplies
+                # certified triangle-inequality seeds strictly above each
+                # row's true kth distance, so strict-< adoption keeps the
+                # answer bitwise identical while the prune starts tighter)
+                heap = pvary(init_candidates(qpad, k, qr))
                 resident = BucketedPoints(bpts, bids, blo, bhi, bids)
                 kw = dict(with_stats=True, canonical_ties=canonical,
                           score_dtype=score_dtype, point_norms2=bn2)
@@ -608,25 +615,26 @@ class ResidentKnnEngine:
                 # makes executed/possible comparable across bucketings
                 return finish(st, jnp.reshape(tiles * s_q, (1,)))
 
-            in_specs = (P(AXIS),) * (5 if use_mxu else 4) + (P(),)
+            in_specs = (P(AXIS),) * (5 if use_mxu else 4) + (P(), P())
         else:
 
-            def body(spts, sids, q):
-                heap = pvary(init_candidates(qpad, k, max_radius))
+            def body(spts, sids, q, qr):
+                heap = pvary(init_candidates(qpad, k, qr))
                 st = knn_update_bruteforce(heap, q, spts, sids,
                                            score_dtype=score_dtype)
                 # flat engines score every pair; no tiles to count
                 return finish(st, pvary(jnp.zeros((1,), jnp.int32)))
 
-            in_specs = (P(AXIS),) * 2 + (P(),)
+            in_specs = (P(AXIS),) * 2 + (P(), P())
 
         check_vma = not engine_name.startswith("pallas")
-        # donate the staged query buffer: each dispatch stages a fresh
-        # replicated batch, so the previous one's device memory is dead the
-        # moment the executable reads it — donation lets XLA reuse it for
-        # the outputs instead of growing the pipelined working set. TPU
-        # only: the CPU PJRT client logs unusable-donation warnings.
-        donate = ((len(in_specs) - 1,)
+        # donate the staged query + radius buffers: each dispatch stages a
+        # fresh replicated batch, so the previous one's device memory is
+        # dead the moment the executable reads it — donation lets XLA
+        # reuse it for the outputs instead of growing the pipelined
+        # working set. TPU only: the CPU PJRT client logs
+        # unusable-donation warnings.
+        donate = ((len(in_specs) - 2, len(in_specs) - 1)
                   if jax.default_backend() == "tpu" else ())
         return jax.jit(jax.shard_map(
             body, mesh=self.mesh, in_specs=in_specs,
@@ -725,8 +733,10 @@ class ResidentKnnEngine:
                                           plan_key=plan_key)
                 q0 = self._stage_replicated(
                     np.full((qpad, self.dim), PAD_SENTINEL, np.float32))
+                r0 = self._stage_replicated(
+                    np.full(qpad, self.max_radius, np.float32))
                 exe = fn.lower(*self._resident_args(engine_name),
-                               q0).compile()
+                               q0, r0).compile()
         except BaseException:
             if self._exec_cache is not None:
                 self._exec_cache.abort(shared_key)
@@ -762,7 +772,9 @@ class ResidentKnnEngine:
                 # init; the traversal early-exits (no real queries)
                 q0 = self._stage_replicated(
                     np.full((qpad, self.dim), PAD_SENTINEL, np.float32))
-                out = exe(*self._resident_args(engine_name), q0)
+                r0 = self._stage_replicated(
+                    np.full(qpad, self.max_radius, np.float32))
+                out = exe(*self._resident_args(engine_name), q0, r0)
                 jax.block_until_ready(out)
                 self._count_tiles(self._tiles_fetch(out[2]),
                                   self._tiles_possible(engine_name, qpad))
@@ -854,7 +866,8 @@ class ResidentKnnEngine:
                 max_workers=n, thread_name_prefix="knn-launch")
             old.shutdown(wait=False)
 
-    def dispatch(self, queries: np.ndarray, plan=None) -> _InFlightBatch:
+    def dispatch(self, queries: np.ndarray, plan=None,
+                 seed_radius=None) -> _InFlightBatch:
         """Issue a batch's device traversal WITHOUT blocking on the result.
 
         Morton-sorts (when enabled), stages + pads the batch, replicates
@@ -872,7 +885,17 @@ class ResidentKnnEngine:
         ``plan`` (serve/recall.py ``RecallPlan``, None = exact) selects
         the plan-keyed approximate executable and rides the handle so a
         degradation replay re-runs the same plan.
-        """
+
+        ``seed_radius`` (f32[n] or None) tightens individual rows' heap
+        init radius below ``max_radius`` — the certified query cache's
+        triangle-inequality seeds (serve/qcache.py). The radius is a
+        RUNTIME operand of the same compiled program (no new AOT keys);
+        rows at +inf / unseeded batches behave exactly as before. A seed
+        must sit STRICTLY above the row's true kth-neighbor distance
+        (rounding up in f32) or candidates at the boundary would be lost
+        to the strict-< adoption; values above ``max_radius`` clamp to
+        it. Degradation replays (serve/admission.py) rerun unseeded —
+        sound, because seeds never change answers, only pruning."""
         import jax
 
         from mpi_cuda_largescaleknn_tpu.utils.math import morton_argsort
@@ -892,6 +915,20 @@ class ResidentKnnEngine:
                 perm = morton_argsort(queries, self._index_lo,
                                       self._index_hi)
         staged = queries if perm is None else queries[perm]
+        # per-row init radii: max_radius everywhere (pad rows included),
+        # seeded rows clamped below it. The seed vector rides the SAME
+        # Morton permutation as the queries, so staged row i keeps the
+        # radius of the query it carries.
+        r = np.full(qpad, self.max_radius, np.float32)
+        if seed_radius is not None:
+            sr = np.asarray(seed_radius, np.float32).reshape(-1)
+            if len(sr) != n:
+                raise ValueError(
+                    f"seed_radius has {len(sr)} rows for {n} queries")
+            sr = np.minimum(sr, np.float32(self.max_radius))
+            r[:n] = sr if perm is None else sr[perm]
+            self.timers.count(
+                "seeded_rows", int(np.sum(sr < self.max_radius)))
         with self._lock:
             exe = self._get_executable(qpad, plan=plan)
             with self._meta_lock:
@@ -903,7 +940,8 @@ class ResidentKnnEngine:
             q[:n] = staged
             t0 = time.perf_counter()
             q_dev = self._stage_replicated(q)
-            fut = self._launch.submit(exe, *args, q_dev)
+            r_dev = self._stage_replicated(r)
+            fut = self._launch.submit(exe, *args, q_dev, r_dev)
             possible = self._tiles_possible(engine_name, qpad)
         if plan is not None:
             self.timers.count("approx_batches")
@@ -1082,7 +1120,7 @@ class ResidentKnnEngine:
         self.timers.count("result_rows", len(rows))
         return rows, np.concatenate(d_l), np.concatenate(n_l)
 
-    def query(self, queries: np.ndarray, plan=None):
+    def query(self, queries: np.ndarray, plan=None, seed_radius=None):
         """f32[n,3] -> (f32[n] k-th-NN distances, i32[n,k] neighbor ids).
 
         Serialized ``dispatch`` + ``complete``. ``n`` may be anything in
@@ -1093,8 +1131,11 @@ class ResidentKnnEngine:
         Neighbor ids are global point indices, ascending by distance, -1 for
         unfilled slots. With a recall ``plan``, distances/sets are the
         plan's approximation instead (still sorted, -1-padded).
+        ``seed_radius`` (serve/qcache.py certified seeds) tightens
+        individual rows' heap-init radius without changing any answer bit.
         """
-        return self.complete(self.dispatch(queries, plan=plan))
+        return self.complete(self.dispatch(queries, plan=plan,
+                                           seed_radius=seed_radius))
 
     def stats(self) -> dict:
         # the mutable identity (engine_name / degraded_reason /

@@ -124,11 +124,13 @@ from mpi_cuda_largescaleknn_tpu.serve.health import (
     HostHealth,
     host_fingerprint,
 )
+from mpi_cuda_largescaleknn_tpu.serve.qcache import QueryCache
 from mpi_cuda_largescaleknn_tpu.serve.recall import RecallPolicy
 from mpi_cuda_largescaleknn_tpu.serve.server import (
     JsonHttpHandler,
     ServingMetrics,
     parse_knn_body,
+    qcache_prometheus_lines,
     recall_response_fields,
     slab_pool_prometheus_lines,
 )
@@ -1276,7 +1278,7 @@ class RoutedPodFanout(PodFanout):
 
     # ---------------------------------------------------------- query_fn API
 
-    def dispatch(self, queries: np.ndarray, plan=None):
+    def dispatch(self, queries: np.ndarray, plan=None, seed_radius=None):
         """Wave 1: each query to its nearest-bounds AVAILABLE slab (one
         picked replica of it), PLUS every available slab whose boxes
         contain it (non-blocking). A zero lower bound can never be
@@ -1292,10 +1294,27 @@ class RoutedPodFanout(PodFanout):
         side only here: the /route_knn wire is unchanged (hosts always
         serve their exact slab partials) and the plan's ``route_slack``
         shaves ``complete``'s escalation margin — fewer boundary waves,
-        bounded recall cost."""
+        bounded recall cost.
+
+        ``seed_radius`` (serve/qcache.py certified radius seeds, exact
+        tier only — dropped under a plan) is frontend-side too: the
+        /route_knn wire is unchanged (hosts serve their full exact slab
+        partials), but ``complete`` starts its escalation radius at the
+        certified seed instead of +inf, so certification closes with
+        fewer escalation waves. The seed sits strictly above the true
+        kth distance, so every slab holding a true top-k or
+        boundary-tied candidate is still visited — identical answer."""
         q = np.ascontiguousarray(np.asarray(queries, np.float32)
                                  .reshape(-1, self.dim))
         n = len(q)
+        seeds = None
+        if seed_radius is not None and plan is None:
+            seeds = np.asarray(seed_radius, np.float32).reshape(-1)
+            if len(seeds) != n:
+                raise ValueError(
+                    f"seed_radius has {len(seeds)} rows for {n} queries")
+            if not np.any(np.isfinite(seeds)):
+                seeds = None
         num_slabs = self.replicas.num_slabs
         lb = self.bounds.lower_bounds(q)
         visited = np.zeros((n, num_slabs), bool)
@@ -1316,15 +1335,17 @@ class RoutedPodFanout(PodFanout):
             for s, _ep_i, rows, _f in futs:
                 visited[rows, s] = True
         return {"q": q, "n": n, "lb": lb, "visited": visited,
-                "futs": futs, "t0": time.perf_counter(), "plan": plan}
+                "futs": futs, "t0": time.perf_counter(), "plan": plan,
+                "seeds": seeds}
 
     #: the front end resolves recall plans only against fan-outs that
     #: accept them; the replicate pod (base class) stays plan-blind and
     #: serves every target exactly
     supports_recall = True
 
-    def __call__(self, queries, plan=None):
-        return self.complete(self.dispatch(queries, plan=plan))
+    def __call__(self, queries, plan=None, seed_radius=None):
+        return self.complete(self.dispatch(queries, plan=plan,
+                                           seed_radius=seed_radius))
 
     def complete(self, handle):
         """Fold wave partials; escalate uncertified (query, slab) pairs.
@@ -1345,6 +1366,14 @@ class RoutedPodFanout(PodFanout):
         n, k = handle["n"], self.k
         cur_d2 = np.full((n, k), np.inf, np.float32)
         cur_idx = np.full((n, k), -1, np.int32)
+        seeds = handle.get("seeds")
+        if seeds is not None:
+            # certified seeds (serve/qcache.py) bound the escalation
+            # radius from wave 1: r2 starts at seed² (> true kth²,
+            # strictly), escalation visits strictly fewer slabs, and the
+            # filler (seed², -1) slots are pushed out before the fold
+            # closes — the final rows are bit-identical to unseeded
+            cur_d2[:] = (seeds * seeds)[:, None]
         if n == 0:
             return (np.zeros(0, np.float32), cur_idx,
                     np.zeros(0, bool))
@@ -1660,16 +1689,19 @@ class FrontendServer(ThreadingHTTPServer):
     def __init__(self, addr, fanout: PodFanout, *, max_delay_s=0.002,
                  max_queue_rows=4096, default_timeout_s=5.0,
                  pipeline_depth=2, min_batch=8, on_host_loss="fail",
-                 verbose=False, recall_policy=None):
+                 verbose=False, recall_policy=None,
+                 qcache_rows=4096, qcache_seed_rows=512):
         if on_host_loss not in ("fail", "degrade"):
             raise ValueError(f"on_host_loss must be 'fail' or 'degrade', "
                              f"got {on_host_loss!r}")
         self.fanout = fanout
         #: recall-SLO tier (serve/recall.py). Plans only engage on a
         #: routed fan-out (``supports_recall``); a replicate pod serves
-        #: every target exactly — exact always meets any target.
-        self.recall_policy = (RecallPolicy() if recall_policy is None
-                              else recall_policy)
+        #: every target exactly — exact always meets any target. The
+        #: built-in default table is k-conditioned on the pod's k.
+        self.recall_policy = (
+            RecallPolicy.for_k(getattr(fanout, "k", None))
+            if recall_policy is None else recall_policy)
         #: what happens to queries whose certified routing set touches a
         #: drained slab: "fail" 503s them (exactness preserved), "degrade"
         #: serves the surviving hosts' fold flagged ``exact: false``
@@ -1680,11 +1712,24 @@ class FrontendServer(ThreadingHTTPServer):
         self.admission = AdmissionController(
             max_queue_rows=max_queue_rows,
             default_timeout_s=default_timeout_s)
+        #: certified query cache (serve/qcache.py): exact hits and dedup
+        #: on any pod; radius seeds only on a routed fan-out (a replicate
+        #: pod folds every host anyway — a tightened radius saves nothing
+        #: on its wire, so seeding stays off there)
+        self.qcache = None
+        if qcache_rows:
+            seeding = bool(getattr(fanout, "supports_recall", False))
+            self.qcache = QueryCache(
+                capacity_rows=qcache_rows,
+                seed_rows=(qcache_seed_rows if seeding else 0),
+                fingerprint=f"pod:{type(fanout).__name__}"
+                            f":hosts={len(fanout.endpoints)}:k={fanout.k}")
         self.batcher = DynamicBatcher(fanout, max_batch=fanout.max_batch,
                                       max_delay_s=max_delay_s,
                                       timers=fanout.timers,
                                       pipeline_depth=pipeline_depth,
-                                      min_batch=min_batch)
+                                      min_batch=min_batch,
+                                      qcache=self.qcache)
         self.admission.pipeline_rows_fn = self.batcher.inflight_rows
         self.metrics = ServingMetrics()
         # pre-seed the failure-path counters so dashboards see zeros, not
@@ -1768,6 +1813,8 @@ class _FrontendHandler(JsonHttpHandler):
                 "recall": dict(srv.metrics.recall_snapshot(),
                                policy=srv.recall_policy.stats()),
                 "hosts": srv.fanout.scrape_host_stats(),
+                **({"qcache": srv.qcache.stats()}
+                   if srv.qcache is not None else {}),
             })
         elif path == "/metrics":
             self._send(200, self._prometheus(srv).encode(),
@@ -1803,6 +1850,8 @@ class _FrontendHandler(JsonHttpHandler):
         }
         for name, val in gauges.items():
             lines += [f"# TYPE {name} gauge", f"{name} {val}"]
+        # certified query cache (serve/qcache.py), absent when off
+        lines += qcache_prometheus_lines(srv.qcache)
         # per-host health + latency percentiles (straggler hunting): one
         # gauge line per host, labelled by endpoint
         lines += ["# TYPE knn_host_up gauge", "# TYPE knn_host_p99_seconds "
@@ -2181,6 +2230,7 @@ def build_frontend(host_urls: list[str], *, host: str = "127.0.0.1",
                    start_monitor: bool = True,
                    standbys: list[str] | None = None,
                    handoff_floor: int = 1, wire: str = "auto",
+                   qcache_rows: int = 4096, qcache_seed_rows: int = 512,
                    verbose: bool = False) -> FrontendServer:
     """Validate the pod and construct (but do not start) a FrontendServer;
     ``port=0`` picks a free port (``server.server_address[1]``).
@@ -2232,7 +2282,10 @@ def build_frontend(host_urls: list[str], *, host: str = "127.0.0.1",
                             max_queue_rows=max_queue_rows,
                             default_timeout_s=default_timeout_s,
                             min_batch=cfg["min_batch"],
-                            on_host_loss=on_host_loss, verbose=verbose)
+                            on_host_loss=on_host_loss,
+                            qcache_rows=qcache_rows,
+                            qcache_seed_rows=qcache_seed_rows,
+                            verbose=verbose)
     server.monitor = HealthMonitor(fanout,
                                    fingerprints=cfg["fingerprints"],
                                    mode=cfg["routing"])
@@ -2299,6 +2352,16 @@ FRONTEND_FLAGS = """
                     identical: exact f32 re-merge), f32 forces the
                     uncompressed exchange (docs/SERVING.md "Wire formats
                     & negotiation")
+  --qcache-rows N   certified query cache capacity in cached rows
+                    (default 4096; 0 disables the cache entirely —
+                    serve/qcache.py, docs/SERVING.md "Query cache &
+                    radius seeding"). Exact-hit reuse and in-flight
+                    dedup are byte-identical by construction
+  --qcache-seed-rows N  triangle-inequality seed pool rows per tenant
+                    (default 512; 0 disables radius seeding while
+                    keeping the hit/dedup tiers). Seeding applies on
+                    routed pods only — a replicate pod folds every host
+                    regardless
   --verbose         log each HTTP request to stderr
 """
 
@@ -2315,6 +2378,7 @@ def main(argv: list[str] | None = None) -> int:
            "retry_backoff_ms": 50.0, "request_timeout_ms": 0.0,
            "probe_interval_s": 5.0, "fail_threshold": 3,
            "standbys": "", "handoff_floor": 1, "wire": "auto",
+           "qcache_rows": 4096, "qcache_seed_rows": 512,
            "verbose": False}
     i = 0
     try:
@@ -2356,6 +2420,10 @@ def main(argv: list[str] | None = None) -> int:
                 i += 1; opt["handoff_floor"] = int(args[i])
             elif a == "--wire":
                 i += 1; opt["wire"] = args[i]
+            elif a == "--qcache-rows":
+                i += 1; opt["qcache_rows"] = int(args[i])
+            elif a == "--qcache-seed-rows":
+                i += 1; opt["qcache_seed_rows"] = int(args[i])
             elif a == "--verbose":
                 opt["verbose"] = True
             else:
@@ -2386,6 +2454,8 @@ def main(argv: list[str] | None = None) -> int:
         fail_threshold=opt["fail_threshold"],
         standbys=[s for s in opt["standbys"].split(",") if s],
         handoff_floor=opt["handoff_floor"], wire=opt["wire"],
+        qcache_rows=opt["qcache_rows"],
+        qcache_seed_rows=opt["qcache_seed_rows"],
         verbose=opt["verbose"])
     server.ready = True
     h, p = server.server_address[:2]

@@ -138,9 +138,13 @@ EXACT_PLAN = RecallPlan()
 #: so the claims survive index shapes rougher than the fixture; CI
 #: re-measures them end to end (serve_smoke --recall-bench). The floors
 #: do NOT survive k far above the reference (k=64 halves approx-fast's
-#: uniform recall) — run the harness at your fixture's k and load its
-#: output via --recall-policy for calibrated, fixture-specific claims
-#: (docs/SERVING.md "Recall-SLO tier").
+#: uniform recall — a deep heap needs far more of the visit schedule to
+#: fill its tail), which is why the table is K-CONDITIONED: servers
+#: select ``default_plans_for_k(engine.k)``, and deep-k fixtures get the
+#: conservative knob vectors below. For calibrated, fixture-specific
+#: claims run tools/recall_harness.py at YOUR fixture's k and load its
+#: output via --recall-policy (docs/SERVING.md "Recall-SLO tier",
+#: docs/TUNING.md "Recall plans vs k").
 DEFAULT_PLANS = (
     RecallPlan(name="approx-fast", skip_rescore=True, prune_shrink=0.10,
                visit_frac=0.25, route_slack=0.30, stream_skip_cold=True,
@@ -152,6 +156,39 @@ DEFAULT_PLANS = (
                visit_frac=0.85, route_slack=0.05, stream_skip_cold=True,
                recall_estimated=0.99),
 )
+
+#: deep-k (k >= DEEP_K_THRESHOLD) defaults: the kth distance of a deep
+#: heap is far out in the candidate tail, so the same prune/visit cuts
+#: that cost ~0.1 recall at k=16 amputate half the true set at k=64+.
+#: Every knob here is the shallow table's NEXT step up (approx-fast
+#: inherits approx-balanced's knob vector claimed a tier lower, and so
+#: on), keeping the same three-target ladder at honest floors. Measured
+#: at the reference fixture scaled to k=64: 0.88 / 0.97 / 0.995
+#: worst-workload (uniform) — the claims below stay beneath that
+DEFAULT_PLANS_DEEP_K = (
+    RecallPlan(name="approx-fast", skip_rescore=True, prune_shrink=0.30,
+               visit_frac=0.50, route_slack=0.15, stream_skip_cold=True,
+               recall_estimated=0.85),
+    RecallPlan(name="approx-balanced", skip_rescore=True,
+               prune_shrink=0.50, visit_frac=0.70, route_slack=0.10,
+               stream_skip_cold=True, recall_estimated=0.95),
+    RecallPlan(name="approx-near", skip_rescore=True, prune_shrink=0.75,
+               visit_frac=0.92, route_slack=0.03, stream_skip_cold=True,
+               recall_estimated=0.99),
+)
+
+#: k at which the deep-k table takes over for built-in defaults
+DEEP_K_THRESHOLD = 64
+
+
+def default_plans_for_k(k: int | None) -> tuple:
+    """The built-in plan table conditioned on the fixture's k. ``None``
+    (k unknown — e.g. a custom query_fn with no engine) stays on the
+    shallow table: it only changes which UNCALIBRATED floor applies, and
+    the shallow floors are the documented legacy behavior."""
+    if k is not None and k >= DEEP_K_THRESHOLD:
+        return DEFAULT_PLANS_DEEP_K
+    return DEFAULT_PLANS
 
 
 class RecallPolicy:
@@ -228,6 +265,16 @@ class RecallPolicy:
     def from_file(cls, path: str) -> "RecallPolicy":
         with open(path) as f:
             return cls.from_dict(json.load(f), source=path)
+
+    @classmethod
+    def for_k(cls, k: int | None) -> "RecallPolicy":
+        """Built-in defaults conditioned on the served fixture's k —
+        what the servers construct when no --recall-policy table is
+        loaded. Deep k (>= DEEP_K_THRESHOLD) switches to the
+        conservative knob ladder; see DEFAULT_PLANS_DEEP_K."""
+        deep = k is not None and k >= DEEP_K_THRESHOLD
+        return cls(default_plans_for_k(k),
+                   source="builtin:deep-k" if deep else "builtin")
 
 
 def measured_recall(approx_idx, exact_idx) -> float:

@@ -50,6 +50,7 @@ from mpi_cuda_largescaleknn_tpu.serve.faults import (
     FaultInjector,
     apply_http_fault,
 )
+from mpi_cuda_largescaleknn_tpu.serve.qcache import QueryCache
 from mpi_cuda_largescaleknn_tpu.serve.recall import RecallPolicy
 from mpi_cuda_largescaleknn_tpu.serve.tenancy import TenantQuotas
 
@@ -185,6 +186,36 @@ def _tenant_prometheus_lines(srv, engine_stats: dict) -> list[str]:
                 lines += [f"# TYPE {metric} {kind}"] + [
                     f'{metric}{{tenant="{t}"}} {qt[t][key]}'
                     for t in sorted(qt)]
+    return lines
+
+
+def qcache_prometheus_lines(qcache) -> list[str]:
+    """Prometheus lines for the certified query cache (serve/qcache.py):
+    the four reuse counters (+ size/insert gauges), each with a
+    ``{tenant=}`` twin per tenant on multi-tenant servers. Empty when the
+    cache is off — cache-off servers' /metrics text is unchanged. Shared
+    by the single-host server and the pod front end."""
+    if qcache is None:
+        return []
+    qs = qcache.stats()
+    tenants = qs["tenants"]
+    lines = []
+    for metric, key in (("knn_qcache_hits_total", "hits"),
+                        ("knn_qcache_seeds_total", "seeds"),
+                        ("knn_qcache_dedup_rows_total", "dedup_rows"),
+                        ("knn_qcache_evictions_total", "evictions")):
+        lines += [f"# TYPE {metric} counter", f"{metric} {qs[key]}"]
+        lines += [f'{metric}{{tenant="{t}"}} {tenants[t][key]}'
+                  for t in sorted(tenants)]
+    for metric, key in (("knn_qcache_misses_total", "misses"),
+                        ("knn_qcache_inserts_total", "inserts"),
+                        ("knn_qcache_inflight_aborts_total",
+                         "inflight_aborts")):
+        lines += [f"# TYPE {metric} counter", f"{metric} {qs[key]}"]
+    for metric, key in (("knn_qcache_size_rows", "size_rows"),
+                        ("knn_qcache_capacity_rows", "capacity_rows"),
+                        ("knn_qcache_inflight_rows", "inflight_rows")):
+        lines += [f"# TYPE {metric} gauge", f"{metric} {qs[key]}"]
     return lines
 
 
@@ -350,7 +381,8 @@ class KnnServer(ThreadingHTTPServer):
     def __init__(self, addr, engine, *, max_delay_s=0.002,
                  max_queue_rows=4096, default_timeout_s=5.0, query_fn=None,
                  verbose=False, pipeline_depth=2, faults=None,
-                 recall_policy=None, tenant_quota_rows=0):
+                 recall_policy=None, tenant_quota_rows=0,
+                 qcache_rows=4096, qcache_seed_rows=512):
         self.engine = engine
         #: multi-index tenancy (serve/tenancy.py): a MultiTenantEngine
         #: exposes a TenantRegistry — its presence switches on the
@@ -361,10 +393,13 @@ class KnnServer(ThreadingHTTPServer):
         self.quotas = None
         #: recall-SLO tier (serve/recall.py): maps a request's
         #: ``"recall": 0.95`` target to a calibrated cheaper plan. The
-        #: built-in table serves by default; operators swap in a
-        #: harness-calibrated one via --recall-policy (cli/serve_main.py)
-        self.recall_policy = (RecallPolicy() if recall_policy is None
-                              else recall_policy)
+        #: built-in table serves by default, K-CONDITIONED on the
+        #: engine's heap depth (deep k needs gentler knobs); operators
+        #: swap in a harness-calibrated one via --recall-policy
+        #: (cli/serve_main.py)
+        self.recall_policy = (
+            RecallPolicy.for_k(getattr(engine, "k", None))
+            if recall_policy is None else recall_policy)
         #: deterministic fault injection (serve/faults.py; KNN_FAULTS env)
         #: — the single-host twin of the pod hosts' injector, so failure
         #: drills run against any serving tier
@@ -379,6 +414,18 @@ class KnnServer(ThreadingHTTPServer):
                 self.admission, default_quota_rows=tenant_quota_rows)
         self.graceful = (GracefulQueryFn(engine) if query_fn is None
                          else query_fn)
+        #: certified query cache (serve/qcache.py): exact-hit reuse,
+        #: in-flight dedup, triangle-inequality radius seeds.
+        #: ``qcache_rows=0`` turns the whole layer off; a CUSTOM query_fn
+        #: keeps the hit/dedup tiers but disables seeding (seed vectors
+        #: are the only tier that changes the query_fn call signature)
+        self.qcache = None
+        if qcache_rows:
+            self.qcache = QueryCache(
+                capacity_rows=qcache_rows,
+                seed_rows=(qcache_seed_rows if query_fn is None else 0),
+                fingerprint=(f"{engine.engine_name}:n={engine.n_points}"
+                             f":k={engine.k}:dim={engine.dim}"))
         # depth 2 by default: batch t+1's device traversal overlaps batch
         # t's host merge/demux (results identical to depth 1 — the pipeline
         # reorders nothing, it only overlaps). See docs/SERVING.md.
@@ -391,7 +438,8 @@ class KnnServer(ThreadingHTTPServer):
                                       # below the narrowest shape bucket
                                       # keep coalescing while the pipe is
                                       # busy (serve/batcher.py)
-                                      min_batch=engine.shape_buckets[0])
+                                      min_batch=engine.shape_buckets[0],
+                                      qcache=self.qcache)
         self.admission.pipeline_rows_fn = self.batcher.inflight_rows
         if self.batcher.pipelined and hasattr(engine, "set_launch_workers"):
             # let the engine keep as many programs in flight as the
@@ -494,6 +542,8 @@ class _Handler(JsonHttpHandler):
                 "recall": dict(srv.metrics.recall_snapshot(),
                                policy=srv.recall_policy.stats()),
             }
+            if srv.qcache is not None:
+                out["qcache"] = srv.qcache.stats()
             if srv.tenants is not None:
                 out["tenants"] = self._tenant_stats(srv)
             self._send_json(200, out)
@@ -606,6 +656,10 @@ class _Handler(JsonHttpHandler):
         }
         for name, val in gauges.items():
             lines += [f"# TYPE {name} gauge", f"{name} {val}"]
+        # certified query cache (serve/qcache.py): the three reuse tiers'
+        # counters with {tenant=} twins on multi-tenant servers — absent
+        # when the cache is off, so those servers' text is unchanged
+        lines += qcache_prometheus_lines(srv.qcache)
         # tiered slab index (serve/slabpool.py): per-tier residency,
         # promotion/eviction totals, stream-stall accounting — absent for
         # fully-resident engines

@@ -715,9 +715,9 @@ class _StreamHandle:
     (serve/recall.py, None = exact) the batch runs under."""
 
     __slots__ = ("queries", "n", "engine_name", "t0", "lb", "visited",
-                 "subs", "pinned", "plan", "skip_cold")
+                 "subs", "pinned", "plan", "skip_cold", "seeds")
 
-    def __init__(self, queries, n, engine_name, t0, plan=None):
+    def __init__(self, queries, n, engine_name, t0, plan=None, seeds=None):
         self.queries = queries
         self.n = n
         self.engine_name = engine_name
@@ -727,6 +727,10 @@ class _StreamHandle:
         self.subs = []
         self.pinned = set()
         self.plan = plan
+        #: certified per-row init radii (serve/qcache.py; None = unseeded)
+        #: — rides the handle so the fold and every escalation sub-batch
+        #: start their heaps at the same certified bound
+        self.seeds = seeds
         #: dispatch's ADMITTED skip-cold decision for this batch (the
         #: drift guard may refuse the plan's ask); the fold must follow
         #: the same decision or wave 1 and escalation would disagree
@@ -1080,7 +1084,8 @@ class StreamingKnnEngine:
                 return False
             return True
 
-    def dispatch(self, queries: np.ndarray, plan=None) -> _StreamHandle:
+    def dispatch(self, queries: np.ndarray, plan=None,
+                 seed_radius=None) -> _StreamHandle:
         """Wave 1 of the streamed batch: route rows to their
         nearest-bounds slab plus every slab whose box contains them (the
         PR-7 rule — a zero lower bound can never certify away), PIN that
@@ -1101,8 +1106,19 @@ class StreamingKnnEngine:
         queries = np.ascontiguousarray(
             np.asarray(queries, np.float32).reshape(-1, self.dim))
         n = len(queries)
+        # certified radius seeds (serve/qcache.py): exact tier only — an
+        # approximate plan's visit schedule (skip_cold) must not interact
+        # with a tightened init radius, so seeds are dropped under a plan
+        seeds = None
+        if seed_radius is not None and plan is None:
+            seeds = np.asarray(seed_radius, np.float32).reshape(-1)
+            if len(seeds) != n:
+                raise ValueError(
+                    f"seed_radius has {len(seeds)} rows for {n} queries")
+            if not np.any(np.isfinite(seeds)):
+                seeds = None
         handle = _StreamHandle(queries, n, self.engine_name, self._clock(),
-                               plan=plan)
+                               plan=plan, seeds=seeds)
         if n == 0:
             return handle
         lb, want = self._wave1_want(queries)
@@ -1133,10 +1149,18 @@ class StreamingKnnEngine:
         try:
             for s, rows in wave:
                 eng = self._pool.ensure(self._pkey(s))
-                handle.subs.append((
-                    s, rows, eng,
-                    eng.dispatch(queries[rows]) if plan is None
-                    else eng.dispatch(queries[rows], plan=plan)))
+                # seeded slab sub-batch: a slab-local init slot (seed², -1)
+                # only ever displaces candidates with d2 ≥ seed², which sit
+                # strictly beyond the certified global kth — the fold pushes
+                # every filler slot out before certification closes
+                if seeds is not None:
+                    sub = eng.dispatch(queries[rows],
+                                       seed_radius=seeds[rows])
+                elif plan is None:
+                    sub = eng.dispatch(queries[rows])
+                else:
+                    sub = eng.dispatch(queries[rows], plan=plan)
+                handle.subs.append((s, rows, eng, sub))
                 visited[rows, s] = True
         except BaseException:
             # a failed promotion/dispatch must not leak this batch's pins
@@ -1166,6 +1190,14 @@ class StreamingKnnEngine:
         n, k = handle.n, self.k
         cur_d2 = np.full((n, k), np.inf, np.float32)
         cur_idx = np.full((n, k), -1, np.int32)
+        seeds = handle.seeds
+        if seeds is not None:
+            # certified seeds bound the fold's running kth from wave 1 on:
+            # r2 starts at seed² (> the true kth², strictly), so escalation
+            # promotes strictly fewer slabs while every slab holding a true
+            # top-k or boundary-tied candidate still satisfies
+            # lb_safe <= true kth² <= r2 at every wave — identical answer
+            cur_d2[:] = (seeds * seeds)[:, None]
         q, lb, visited = handle.queries, handle.lb, handle.visited
         plan = handle.plan
         # recall plan: (c) shave the escalation margin, (d) never stall
@@ -1219,10 +1251,13 @@ class StreamingKnnEngine:
                 for s in sids:
                     rows = np.nonzero(need[:, s])[0]
                     eng = self._pool.ensure(self._pkey(s))
-                    subs.append((
-                        s, rows, eng,
-                        eng.dispatch(q[rows]) if plan is None
-                        else eng.dispatch(q[rows], plan=plan)))
+                    if seeds is not None:
+                        sub = eng.dispatch(q[rows], seed_radius=seeds[rows])
+                    elif plan is None:
+                        sub = eng.dispatch(q[rows])
+                    else:
+                        sub = eng.dispatch(q[rows], plan=plan)
+                    subs.append((s, rows, eng, sub))
                     visited[rows, s] = True
         finally:
             self._pool.unpin(self._pkeys(handle.pinned))
@@ -1260,8 +1295,9 @@ class StreamingKnnEngine:
                 "emit='candidates' for the routed candidate-row contract")
         return self._complete_fold(handle)
 
-    def query(self, queries: np.ndarray, plan=None):
-        return self.complete(self.dispatch(queries, plan=plan))
+    def query(self, queries: np.ndarray, plan=None, seed_radius=None):
+        return self.complete(self.dispatch(queries, plan=plan,
+                                           seed_radius=seed_radius))
 
     def refetch_exact(self, queries):
         """Survivor re-fetch hook (PR-17 quantized wire): exact f32

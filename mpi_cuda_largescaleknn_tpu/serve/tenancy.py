@@ -316,16 +316,20 @@ class MultiTenantEngine:
 
     # -------------------------------------------------------------- query API
 
-    def dispatch(self, queries, plan=None, tenant: str | None = None):
+    def dispatch(self, queries, plan=None, tenant: str | None = None,
+                 seed_radius=None):
         name, eng = self.resolve(tenant)
-        return _TenantHandle(name, eng.dispatch(queries, plan=plan))
+        kw = {} if seed_radius is None else {"seed_radius": seed_radius}
+        return _TenantHandle(name, eng.dispatch(queries, plan=plan, **kw))
 
     def complete(self, handle: _TenantHandle):
         return self.tenants.get(handle.tenant).complete(handle.inner)
 
-    def query(self, queries, plan=None, tenant: str | None = None):
+    def query(self, queries, plan=None, tenant: str | None = None,
+              seed_radius=None):
         return self.complete(self.dispatch(queries, plan=plan,
-                                           tenant=tenant))
+                                           tenant=tenant,
+                                           seed_radius=seed_radius))
 
     def prefetch_hint(self, queries, tenant: str | None = None) -> None:
         _name, eng = self.resolve(tenant)

@@ -531,6 +531,7 @@ class TestRepoGate:
             "serve/frontend.py": {"PodFanout", "RoutedPodFanout",
                                   "HostSliceServer"},
             "serve/health.py": {"HostHealth", "HealthMonitor"},
+            "serve/qcache.py": {"QueryCache", "SeedPool"},
             "serve/recall.py": {"RecallPolicy"},
             "serve/replica.py": {"ReplicaSet", "ReplicaManager"},
             "serve/server.py": {"ServingMetrics"},

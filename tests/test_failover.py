@@ -395,6 +395,10 @@ def _build_fe(urls, **kw):
     kw.setdefault("retry_backoff_s", 0.001)
     kw.setdefault("fail_threshold", 2)
     kw.setdefault("start_monitor", False)
+    # these tests re-post identical probes to drive the failure paths;
+    # the exact-hit query cache would serve the repeat without ever
+    # reaching the faulted host, so it stays off here
+    kw.setdefault("qcache_rows", 0)
     srv = build_frontend(urls, port=0, pipeline_depth=2, **kw)
     srv.ready = True
     threading.Thread(target=srv.serve_forever, daemon=True).start()

@@ -73,10 +73,16 @@ if [ "${SERVE_BENCH:-1}" != "0" ] && [ "$rc" -ne 124 ]; then
   # total memory — gated on aggregate goodput >= 1.3x isolated, cold
   # tenant p99 bounded, per-tenant bitwise parity vs the isolated
   # twins, and compile count staying flat across tenants
-  timeout -k 10 3900 python tools/serve_smoke.py --duration 2 --trials 3 \
+  # --cache-bench adds the certified query-cache section
+  # (cache_compare): a revisit-heavy stream (exact replays + jittered
+  # revisits) at a cache-enabled server vs a cache-off twin over one
+  # shared engine — gated on revisit q/s >= 1.5x the twin,
+  # seeded-vs-unseeded bitwise parity, hit-path responses
+  # byte-identical, and compile count staying flat under seeded traffic
+  timeout -k 10 4500 python tools/serve_smoke.py --duration 2 --trials 3 \
       --locality-bench --multihost-bench --kernel-bench --routing-bench \
       --chaos-bench --replica-bench --streaming-bench --recall-bench \
-      --wire-bench --tenancy-bench \
+      --wire-bench --tenancy-bench --cache-bench \
       --out BENCH_serve.json >/dev/null || { brc=$?; [ "$rc" -eq 0 ] && rc=$brc; }
 fi
 # the lskcheck gate blocks even when the tests pass (and never masks a

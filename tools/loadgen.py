@@ -200,6 +200,34 @@ def _server_pipeline_stats(url: str, timeout_s: float) -> dict | None:
         # negotiated and the observed exchange bytes-per-row — the
         # compression ratio as measured by the server, not the bench
         **_wire_projection(stats),
+        # certified query-cache surface (serve/qcache.py): how much
+        # device work the exact-hit / dedup / radius-seeding tiers
+        # actually removed, per the server's own counters
+        **_qcache_projection(stats),
+    }
+
+
+def _qcache_projection(stats: dict) -> dict:
+    """Hit/seed/dedup rates from the server's qcache block. Hit rate is
+    over lookups (hits + misses); seed rate is the fraction of MISSED
+    rows that still got a certified radius seed — the triangle-inequality
+    tier's coverage of the revisit stream. An old server (or one launched
+    with --qcache-rows 0) has no block and projects nothing."""
+    qc = stats.get("qcache")
+    if not qc:
+        return {}
+    lookups = qc.get("hits", 0) + qc.get("misses", 0)
+    return {
+        "qcache_hits": qc.get("hits"),
+        "qcache_misses": qc.get("misses"),
+        "qcache_hit_rate": (round(qc.get("hits", 0) / lookups, 4)
+                            if lookups else None),
+        "qcache_seeds": qc.get("seeds"),
+        "qcache_seed_rate": (round(qc.get("seeds", 0) / qc["misses"], 4)
+                             if qc.get("misses") else None),
+        "qcache_dedup_rows": qc.get("dedup_rows"),
+        "qcache_evictions": qc.get("evictions"),
+        "qcache_size_rows": qc.get("size_rows"),
     }
 
 
@@ -256,7 +284,9 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
              retry_after_cap_s: float = 1.0,
              recall: float | None = None,
              tenants: list[str] | None = None,
-             tenant_skew: float = 0.0) -> dict:
+             tenant_skew: float = 0.0,
+             dup_frac: float = 0.0,
+             revisit_sigma: float = 0.0) -> dict:
     """Drive the server; returns the JSON-able report (also the test API).
 
     ``qps > 0`` switches to open loop: the request schedule is fixed at
@@ -296,6 +326,19 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
     forces a tiered slab pool (serve/slabpool.py) through real
     eviction/readmission cycles, where clustered/uniform streams never
     evict again once warm.
+
+    ``dup_frac``/``revisit_sigma`` shape the stream for the certified
+    query cache (serve/qcache.py): every FRESH batch enters a bounded
+    shared pool of issued batches. With probability ``dup_frac`` a
+    request replays a pooled batch byte-identically — the exact-hit and
+    in-flight-dedup tiers' traffic. With ``revisit_sigma > 0`` three
+    quarters of the remaining requests re-ask a pooled batch jittered
+    by a per-row Gaussian of sigma ``revisit_sigma * scale`` (the last
+    quarter stays fresh draws so the pool keeps churning) —
+    near-duplicates the triangle-inequality radius-seeding tier
+    certifies. The report's ``server`` scrape then projects the cache's
+    own hit/seed/dedup rates next to the measured q/s (docs/SERVING.md
+    "Query cache & radius seeding").
 
     ``tenants`` switches to multi-index mode against a tenanted server
     (serve/tenancy.py): each request picks a tenant name and posts to
@@ -347,6 +390,12 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
                          "net_errors": 0}
                      for t in tenant_names}
     hc_hists = {"hot": LatencyHistogram(), "cold": LatencyHistogram()}
+    # query-reuse pool (serve/qcache.py workloads): fresh batches are
+    # remembered here so --dup-frac can replay one byte-identically and
+    # --revisit can re-ask one jittered; bounded, random-replacement so
+    # long runs keep mixing recent and old anchors
+    issued_pool: list[np.ndarray] = []
+    issued_cap = 64
     stop_at = time.monotonic() + duration_s
 
     def account(endpoint: str, status: int, dt: float, rows: int,
@@ -398,20 +447,50 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
     def one_request(pick_client, rng: np.random.Generator):
         """Fire one request; returns a Retry-After backoff (seconds) the
         caller should honor, or None."""
-        if workload == "clustered":
-            c = centers[rng.integers(len(centers))]
-            q = np.clip(c + rng.normal(0.0, blob_sigma * scale, (batch, 3)),
+        q = None
+        if dup_frac > 0 or revisit_sigma > 0:
+            with lock:
+                prev = (issued_pool[int(rng.integers(len(issued_pool)))]
+                        if issued_pool else None)
+            if prev is not None:
+                u = rng.random()
+                if u < dup_frac:
+                    # byte-identical replay: the exact-hit tier (and,
+                    # under enough concurrency, the in-flight dedup tier)
+                    q = prev
+                elif revisit_sigma > 0 and u < dup_frac + 0.75 * (
+                        1.0 - dup_frac):
+                    # near-duplicate revisit: the radius-seeding tier
+                    q = np.clip(
+                        prev + rng.normal(0.0, revisit_sigma * scale,
+                                          prev.shape),
                         0.0, scale).astype(np.float32)
-        elif workload == "sweep":
-            # drifting window: position along the box diagonal is a pure
-            # function of elapsed time, so the hot slab set moves through
-            # the index at a controlled rate (eviction/readmission churn)
-            frac = ((time.monotonic() - t_start) / sweep_period_s) % 1.0
-            c = np.full(3, frac * scale)
-            q = np.clip(c + rng.normal(0.0, blob_sigma * scale, (batch, 3)),
-                        0.0, scale).astype(np.float32)
-        else:
-            q = (rng.random((batch, 3)) * scale).astype(np.float32)
+        if q is None:
+            if workload == "clustered":
+                c = centers[rng.integers(len(centers))]
+                q = np.clip(
+                    c + rng.normal(0.0, blob_sigma * scale, (batch, 3)),
+                    0.0, scale).astype(np.float32)
+            elif workload == "sweep":
+                # drifting window: position along the box diagonal is a
+                # pure function of elapsed time, so the hot slab set moves
+                # through the index at a controlled rate
+                # (eviction/readmission churn)
+                frac = ((time.monotonic() - t_start) / sweep_period_s) % 1.0
+                c = np.full(3, frac * scale)
+                q = np.clip(
+                    c + rng.normal(0.0, blob_sigma * scale, (batch, 3)),
+                    0.0, scale).astype(np.float32)
+            else:
+                q = (rng.random((batch, 3)) * scale).astype(np.float32)
+            if dup_frac > 0 or revisit_sigma > 0:
+                # only FRESH batches enter the reuse pool: replays and
+                # revisits anchor to originals, never to each other
+                with lock:
+                    if len(issued_pool) < issued_cap:
+                        issued_pool.append(q)
+                    else:
+                        issued_pool[int(rng.integers(issued_cap))] = q
         tenant = None
         if tenant_names:
             tenant = tenant_names[int(rng.choice(len(tenant_names),
@@ -591,6 +670,8 @@ def run_load(url: str, *, duration_s: float = 5.0, concurrency: int = 4,
            if workload == "clustered" else {}),
         **({"blob_sigma": blob_sigma, "sweep_period_s": sweep_period_s}
            if workload == "sweep" else {}),
+        **({"dup_frac": dup_frac, "revisit_sigma": revisit_sigma}
+           if (dup_frac > 0 or revisit_sigma > 0) else {}),
         "url": url, "duration_s": round(elapsed, 3),
         "concurrency": concurrency, "batch": batch, "binary": binary,
         "offered_qps": qps if qps > 0 else None,
@@ -672,6 +753,18 @@ def main(argv=None) -> int:
     ap.add_argument("--sweep-period", type=float, default=2.0,
                     help="sweep: seconds per full diagonal traversal "
                          "(wrapping)")
+    ap.add_argument("--dup-frac", type=float, default=0.0,
+                    help="fraction of requests replaying a previously "
+                         "issued batch byte-identically — the certified "
+                         "query cache's exact-hit / in-flight-dedup "
+                         "traffic (docs/SERVING.md 'Query cache & radius "
+                         "seeding')")
+    ap.add_argument("--revisit", type=float, default=0.0, metavar="SIGMA",
+                    help=">0: most non-duplicate requests re-ask a "
+                         "previously issued batch jittered by a per-row "
+                         "Gaussian of sigma SIGMA*scale — the "
+                         "near-duplicate stream the cache's "
+                         "triangle-inequality radius seeding certifies")
     ap.add_argument("--recall", type=float, default=None,
                     help="attach this recall-SLO target to every request "
                          "(JSON body key / binary query string); the "
@@ -724,7 +817,8 @@ def main(argv=None) -> int:
                       sweep_period_s=a.sweep_period, hosts=hosts,
                       retry_after_cap_s=a.retry_after_cap,
                       recall=a.recall, tenants=tenant_names,
-                      tenant_skew=tenant_skew)
+                      tenant_skew=tenant_skew, dup_frac=a.dup_frac,
+                      revisit_sigma=a.revisit)
     text = json.dumps(report, indent=2)
     print(text)
     if a.out:

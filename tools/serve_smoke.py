@@ -32,8 +32,13 @@ multi-index tenancy drill — N zipf-skewed tenants behind ONE shared
 device byte budget vs N isolated single-tenant servers at equal total
 memory, gated on an aggregate q/s floor, per-tenant bitwise probe
 parity vs the isolated twins, a flat warmup compile count, and a
-cold-tenant p99 ceiling (``tenancy_compare``; tools/ci_tier1.sh passes
-all flags).
+cold-tenant p99 ceiling (``tenancy_compare``), plus (``--cache-bench``)
+the certified query-cache drill — a revisit-heavy stream (exact replays
++ jittered revisits) at a cache-enabled server vs a cache-off twin over
+one shared engine, gated on revisit q/s >= 1.5x the twin,
+seeded-vs-unseeded BITWISE parity, hit-path byte identity, and a flat
+compile count under seeded traffic (``cache_compare``;
+tools/ci_tier1.sh passes all flags).
 
 Boots the full serving stack in-process on a CPU fixture (default: one
 virtual device, single-threaded Eigen, tiled engine — one core per
@@ -131,7 +136,8 @@ def _pod_env() -> dict:
 def _run_loadgen(base_url, *, duration_s, concurrency, batch, seed,
                  workload="uniform", blobs=8, blob_sigma=0.02,
                  sweep_period=None, recall=None, tenants=None,
-                 tenant_skew=None, qps=None) -> dict:
+                 tenant_skew=None, qps=None, dup_frac=None,
+                 revisit=None) -> dict:
     """Drive tools/loadgen.py as a SUBPROCESS: the client's request work
     must not share this interpreter's GIL with the server's handler,
     batcher, and merge threads, or the measurement throttles the thing it
@@ -156,6 +162,9 @@ def _run_loadgen(base_url, *, duration_s, concurrency, batch, seed,
                 "--tenant-skew", f"zipf:{tenant_skew or 0:g}"]
                if tenants else [])
             + (["--qps", str(qps)] if qps else [])
+            + (["--dup-frac", str(dup_frac)]
+               if dup_frac is not None else [])
+            + (["--revisit", str(revisit)] if revisit is not None else [])
             + ["--out", out_path],
             check=True, stdout=subprocess.DEVNULL, timeout=duration_s + 120)
         with open(out_path) as f:
@@ -480,8 +489,10 @@ def run_streaming_bench(*, n_points=16384, k=16, num_slabs=8,
     eng.slab_pool.set_device_budget(budget)
     eng.warmup()
     index_bytes = eng.slab_device_bytes * num_slabs
+    # qcache off: the post-churn parity probe re-posts the SAME batch —
+    # an exact-hit would bypass the slab pool this bench exists to gate
     srv = build_server(eng, port=0, max_delay_s=max_delay_s,
-                       pipeline_depth=2)
+                       pipeline_depth=2, qcache_rows=0)
     srv.ready = True
     threading.Thread(target=srv.serve_forever, daemon=True).start()
     base = f"http://127.0.0.1:{srv.server_address[1]}"
@@ -695,8 +706,10 @@ def run_tenancy_bench(*, tenants=6, points_per_tenant=8192, k=8,
     shared.slab_pool.set_device_budget(budget)
     warm = shared.warmup()
     shared_compiles = int(warm["compile_count"])
+    # qcache off (both phases): parity + re-warm probes re-post one
+    # batch — cached hits would neither touch slabs nor re-warm residency
     srv = build_server(shared, port=0, max_delay_s=max_delay_s,
-                       pipeline_depth=3)
+                       pipeline_depth=3, qcache_rows=0)
     srv.ready = True
     threading.Thread(target=srv.serve_forever, daemon=True).start()
     base = f"http://127.0.0.1:{srv.server_address[1]}"
@@ -715,7 +728,7 @@ def run_tenancy_bench(*, tenants=6, points_per_tenant=8192, k=8,
             e.slab_pool.set_device_budget(iso_budget)
             e.warmup()
             s = build_server(e, port=0, max_delay_s=max_delay_s,
-                             pipeline_depth=3)
+                             pipeline_depth=3, qcache_rows=0)
             s.ready = True
             threading.Thread(target=s.serve_forever, daemon=True).start()
             iso[n] = (e, s, f"http://127.0.0.1:{s.server_address[1]}")
@@ -1309,8 +1322,11 @@ def run_routing_bench(*, n_points=32768, k=64, hosts=2, duration_s=2.0,
                         else "<running>" for p in pod["procs"]]
                 return {"kind": "serve_routing_bench", "hosts": hosts,
                         "error": f"{name}: {e} :: {errs}"}
+            # qcache off: this ratio isolates ROUTING; radius seeding
+            # would accrue only to the routed side and skew it
             fe = build_frontend(pod["urls"], port=0,
-                                max_delay_s=max_delay_s, pipeline_depth=2)
+                                max_delay_s=max_delay_s, pipeline_depth=2,
+                                qcache_rows=0)
             fe.ready = True
             threading.Thread(target=fe.serve_forever, daemon=True).start()
             pod["fe"] = fe
@@ -1435,10 +1451,12 @@ def run_chaos_bench(*, n_points=8192, k=16, hosts=2, duration_s=2.0,
     urls = [f"http://127.0.0.1:{s.server_address[1]}" for s in servers]
     victim = urls[-1]
 
+    # qcache off: the outage probes re-post one batch across phases —
+    # an exact-hit would serve it without ever reaching the faulted host
     fe = build_frontend(
         urls, port=0, max_delay_s=max_delay_s, pipeline_depth=2,
         on_host_loss="degrade", retries=2, retry_backoff_s=0.01,
-        request_timeout_s=30.0,
+        request_timeout_s=30.0, qcache_rows=0,
         health_config=dict(fail_threshold=2, probe_interval_s=0.1,
                            backoff_base_s=0.05, backoff_cap_s=0.5))
     fe.ready = True
@@ -1609,15 +1627,19 @@ def run_replica_bench(*, n_points=6144, k=8, slabs=2, replicas=2,
     sb_url = f"http://127.0.0.1:{standby.server_address[1]}"
     hc = dict(fail_threshold=2, probe_interval_s=0.1,
               backoff_base_s=0.05, backoff_cap_s=0.5)
+    # qcache off on both: the kill/handoff probes re-post one batch —
+    # cached hits would mask the replica-spread and post-handoff paths
     fe2 = build_frontend(urls_r2, port=0, max_delay_s=max_delay_s,
                          pipeline_depth=2, on_host_loss="degrade",
                          retries=2, retry_backoff_s=0.01,
                          request_timeout_s=30.0, standbys=[sb_url],
-                         handoff_floor=replicas, health_config=hc)
+                         handoff_floor=replicas, health_config=hc,
+                         qcache_rows=0)
     fe1 = build_frontend(urls_r1, port=0, max_delay_s=max_delay_s,
                          pipeline_depth=2, on_host_loss="degrade",
                          retries=2, retry_backoff_s=0.01,
-                         request_timeout_s=30.0, health_config=hc)
+                         request_timeout_s=30.0, health_config=hc,
+                         qcache_rows=0)
     for fe in (fe1, fe2):
         fe.ready = True
         threading.Thread(target=fe.serve_forever, daemon=True).start()
@@ -1929,8 +1951,11 @@ def run_wire_bench(*, n_points=16384, k=16, handoff_rows=131072,
             cell: dict = {}
             res = {}
             for mode in ("f32", "auto"):
+                # qcache off: bytes-per-row must count every row's
+                # exchange; reuse would undercount the wire under test
                 fe = build_frontend(urls, port=0, max_delay_s=0.004,
-                                    pipeline_depth=2, wire=mode)
+                                    pipeline_depth=2, wire=mode,
+                                    qcache_rows=0)
                 fe.ready = True
                 threading.Thread(target=fe.serve_forever,
                                  daemon=True).start()
@@ -2028,6 +2053,125 @@ def run_wire_bench(*, n_points=16384, k=16, handoff_rows=131072,
             srv.shutdown()
         stream0.close()
     return out
+
+
+def run_cache_bench(*, n_points=32768, k=16, duration_s=2.0,
+                    concurrency=4, batch=64, max_batch=128,
+                    max_delay_s=0.008, trials=2, seed=0,
+                    dup_frac=0.7, revisit_sigma=0.01,
+                    qps_floor=1.5) -> dict:
+    """Certified query cache (serve/qcache.py) on a revisit-heavy
+    stream: the SAME offered workload (``--dup-frac`` exact replays +
+    Gaussian-jittered revisits of a bounded issued pool) is driven at a
+    cache-enabled server and a ``qcache_rows=0`` twin over ONE shared
+    warm engine, interleaved per trial so drift hits both.
+
+    Four gates ride the exit code (``cache_compare`` in
+    BENCH_serve.json): (1) revisit-workload q/s >= ``qps_floor`` x the
+    cache-off twin — exact hits must actually skip device work; (2)
+    seeded-vs-unseeded BITWISE parity at the engine tier — a probe batch
+    near cached anchors, heaps initialized at the certified
+    triangle-inequality radius, must reproduce the unseeded dists AND
+    neighbor ids exactly; (3) hit-path byte identity — the same JSON
+    body posted twice returns identical response BYTES; (4) flat compile
+    count across the measured cached traffic — the per-query seed radius
+    is a dynamic operand, so seeding must mint zero new programs."""
+    _setup_cpu_fixture(1)
+    from mpi_cuda_largescaleknn_tpu.parallel.mesh import get_mesh
+    from mpi_cuda_largescaleknn_tpu.serve.engine import ResidentKnnEngine
+    from mpi_cuda_largescaleknn_tpu.serve.qcache import certified_seeds
+    from mpi_cuda_largescaleknn_tpu.serve.server import build_server
+
+    rng = np.random.default_rng(seed)
+    points = rng.random((n_points, 3)).astype(np.float32)
+    mesh = get_mesh(1)
+    eng = ResidentKnnEngine(points, k, mesh=mesh, engine="tiled",
+                            bucket_size=64, max_batch=max_batch,
+                            min_batch=16)
+    eng.warmup()
+    # mint every pow2 shape bucket the load can touch BEFORE the
+    # compile-flat window opens — coalescing makes the bucket sequence
+    # timing-dependent, the bucket SET is not
+    b = 16
+    while b <= max_batch:
+        eng.query(rng.random((b, 3)).astype(np.float32))
+        b *= 2
+
+    def boot(rows):
+        srv = build_server(eng, port=0, max_delay_s=max_delay_s,
+                           pipeline_depth=2, qcache_rows=rows,
+                           qcache_seed_rows=512 if rows else 0)
+        srv.ready = True
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+    srv_on, base_on = boot(4096)
+    srv_off, base_off = boot(0)
+    try:
+        # gate (2): engine-tier seeded bitwise parity, deterministic
+        arng = np.random.default_rng(seed + 3)
+        anchors = arng.random((64, 3)).astype(np.float32)
+        a_d, _a_n = eng.query(anchors)
+        probes = np.clip(
+            anchors + arng.normal(0.0, revisit_sigma,
+                                  anchors.shape).astype(np.float32),
+            0.0, 1.0).astype(np.float32)
+        seeds = certified_seeds(probes, anchors,
+                                np.asarray(a_d, np.float32))
+        sd, sn = eng.query(probes, seed_radius=seeds)
+        ud, un = eng.query(probes)
+        seeded_bitwise = (np.array_equal(np.asarray(sd), np.asarray(ud))
+                         and np.array_equal(np.asarray(sn),
+                                            np.asarray(un)))
+        cc0 = eng.compile_count
+        reps_on, reps_off = [], []
+        for trial in range(trials):
+            for base, reps in ((base_on, reps_on), (base_off, reps_off)):
+                reps.append(_run_loadgen(
+                    base, duration_s=duration_s, concurrency=concurrency,
+                    batch=batch, seed=seed + trial, workload="uniform",
+                    dup_frac=dup_frac, revisit=revisit_sigma))
+        compile_flat = eng.compile_count == cc0
+        # gate (3): hit-path byte identity over live HTTP
+        hp = arng.random((16, 3)).astype(np.float32)
+        body = json.dumps({"queries": hp.tolist(),
+                           "neighbors": True}).encode()
+
+        def raw_post():
+            req = urllib.request.Request(
+                base_on + "/knn", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                return resp.read()
+
+        hit_bytes_identical = raw_post() == raw_post()
+        with urllib.request.urlopen(base_on + "/stats",
+                                    timeout=30) as resp:
+            qc_stats = json.loads(resp.read()).get("qcache", {})
+        oracle = _probe_oracle_exact(base_on, points, k, seed)
+    finally:
+        srv_on.close()
+        srv_off.close()
+    med_on = sorted(r["qps"] for r in reps_on)[len(reps_on) // 2]
+    med_off = sorted(r["qps"] for r in reps_off)[len(reps_off) // 2]
+    ratio = med_on / med_off if med_off else None
+    return {
+        "kind": "serve_cache_bench", "n_points": n_points, "k": k,
+        "duration_s": duration_s, "concurrency": concurrency,
+        "batch": batch, "trials": trials, "dup_frac": dup_frac,
+        "revisit_sigma": revisit_sigma,
+        "qps_cache_on": med_on, "qps_cache_off": med_off,
+        "qps_on_trials": [r["qps"] for r in reps_on],
+        "qps_off_trials": [r["qps"] for r in reps_off],
+        "qps_ratio": round(ratio, 3) if ratio else None,
+        "qps_floor": qps_floor,
+        "qps_ok": bool(ratio and ratio >= qps_floor),
+        "seeded_bitwise": bool(seeded_bitwise),
+        "hit_bytes_identical": bool(hit_bytes_identical),
+        "compile_flat": bool(compile_flat),
+        "qcache": qc_stats,
+        "oracle_exact": bool(oracle),
+    }
 
 
 def main(argv=None) -> int:
@@ -2143,6 +2287,17 @@ def main(argv=None) -> int:
     ap.add_argument("--tenancy-child", action="store_true",
                     help="internal: run ONLY the tenancy bench in this "
                          "process (1-device fixture) and print its JSON")
+    ap.add_argument("--cache-bench", action="store_true",
+                    help="also run the certified query-cache bench "
+                         "(revisit-heavy stream at a cache-enabled "
+                         "server vs a cache-off twin: q/s floor, "
+                         "seeded-vs-unseeded bitwise parity, hit-path "
+                         "byte identity, flat compile count) in a "
+                         "subprocess and embed cache_compare")
+    ap.add_argument("--cache-child", action="store_true",
+                    help="internal: run ONLY the cache bench in this "
+                         "process (1-device single-thread fixture) and "
+                         "print its JSON")
     ap.add_argument("--kernel-bench", action="store_true",
                     help="also run the distance-kernel bench (elementwise "
                          "VPU vs MXU matmul-form at D in {3, 8, 64}) in a "
@@ -2189,6 +2344,22 @@ def main(argv=None) -> int:
         report = run_kernel_bench(n_points=a.points, k=a.k, seed=a.seed)
         print(json.dumps(report, indent=2))
         return 0 if report.get("exact_bitwise") else 1
+
+    if a.cache_child:
+        # the cache bench pins its OWN fixture shape (32k points, k=16,
+        # one shared warm engine behind a cache-on and a cache-off
+        # server — see run_cache_bench: the win lives in hit requests
+        # skipping device work entirely, which needs compute-bound
+        # batches); only the timing knobs ride through
+        report = run_cache_bench(
+            duration_s=a.duration, concurrency=a.concurrency,
+            batch=min(a.batch, 64), trials=max(2, a.trials),
+            max_delay_s=a.max_delay_ms / 1e3, seed=a.seed)
+        print(json.dumps(report, indent=2))
+        return 0 if (report.get("qps_ok")
+                     and report.get("seeded_bitwise")
+                     and report.get("hit_bytes_identical")
+                     and report.get("compile_flat")) else 1
 
     if a.tenancy_child:
         # the tenancy bench pins its OWN fixture shape (3 tenants x 8k
@@ -2447,6 +2618,42 @@ def main(argv=None) -> int:
                 detail = (raw.decode(errors="replace")
                           if isinstance(raw, bytes) else str(raw))[-1500:]
             report["tenancy_compare"] = {
+                "error": f"{str(e)[:300]} :: {detail}"}
+    if a.cache_bench:
+        # same subprocess discipline: the cache child pins the 1-device
+        # single-thread fixture. ALL FOUR cache gates ride the exit code
+        # (the query-cache issue's acceptance bar): revisit-workload q/s
+        # >= the floor multiple of the cache-off twin, seeded-vs-unseeded
+        # bitwise parity at the engine tier, hit-path responses
+        # byte-identical over live HTTP, and a flat compile count across
+        # the seeded traffic (the per-query radius is a dynamic operand,
+        # never a new program)
+        try:
+            child = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--cache-child",
+                 "--duration", str(a.duration),
+                 "--concurrency", str(a.concurrency),
+                 "--batch", str(a.batch), "--trials", str(a.trials),
+                 "--max-delay-ms", str(a.max_delay_ms),
+                 "--seed", str(a.seed)],
+                capture_output=True, text=True, env=env,
+                timeout=600 + a.duration * (a.trials + 2) * 8)
+            cb = json.loads(child.stdout)
+            report["cache_compare"] = cb
+            if "error" not in cb:  # infra hiccups degrade, never gate
+                ok = (ok and bool(cb.get("qps_ok"))
+                      and bool(cb.get("seeded_bitwise"))
+                      and bool(cb.get("hit_bytes_identical"))
+                      and bool(cb.get("compile_flat")))
+        except (subprocess.TimeoutExpired, json.JSONDecodeError) as e:
+            if isinstance(e, json.JSONDecodeError):
+                detail = (child.stderr or child.stdout or "")[-1500:]
+            else:
+                raw = e.stderr or e.stdout or b""
+                detail = (raw.decode(errors="replace")
+                          if isinstance(raw, bytes) else str(raw))[-1500:]
+            report["cache_compare"] = {
                 "error": f"{str(e)[:300]} :: {detail}"}
     if a.recall_bench:
         # same subprocess discipline: the recall child pins the 1-device
